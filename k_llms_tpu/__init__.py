@@ -1,0 +1,13 @@
+"""k-llms-tpu: TPU-native k-way consensus LLM framework.
+
+Drop-in replacement for the k-LLMs SDK (`/root/reference/k_llms/__init__.py`)
+whose model layer is a local JAX/XLA engine on a TPU device mesh instead of the
+OpenAI HTTP API. ``choices[0]`` = consensus, ``choices[1..n]`` = samples,
+``likelihoods`` = per-field confidence (same contract as the reference README:112-114).
+"""
+
+from .client import AsyncKLLMs, KLLMs
+
+__version__ = "0.1.0"
+
+__all__ = ["KLLMs", "AsyncKLLMs"]

@@ -1,0 +1,14 @@
+"""Model-execution backends.
+
+The reference's "model layer" is the remote OpenAI HTTP API
+(`/root/reference/k_llms/resources/completions/completions.py:73,134`). Here it is
+a pluggable :class:`Backend`: ``tpu`` (local JAX/XLA engine), ``fake``
+(deterministic scripted completions for hermetic tests — the fixture layer the
+reference never shipped, SURVEY.md §4), and ``openai`` (HTTP passthrough when the
+``openai`` package is installed).
+"""
+
+from .base import Backend, ChatRequest, resolve_backend
+from .fake import FakeBackend
+
+__all__ = ["Backend", "ChatRequest", "FakeBackend", "resolve_backend"]

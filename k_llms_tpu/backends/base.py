@@ -1,0 +1,70 @@
+"""Backend protocol: what the resources layer needs from a model engine."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from ..types import ChatCompletion
+
+
+@dataclass
+class ChatRequest:
+    """Normalized chat-completion request (mirrors the reference's call_params,
+    `/root/reference/k_llms/resources/completions/completions.py:42-64`)."""
+
+    messages: List[Dict[str, Any]]
+    model: str
+    n: int = 1
+    temperature: Optional[float] = None
+    max_tokens: Optional[int] = None
+    top_p: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    stop: Optional[Union[str, List[str]]] = None
+    seed: Optional[int] = None
+    response_format: Optional[Any] = None
+    logprobs: Optional[bool] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class Backend(abc.ABC):
+    """A model engine that can answer one n-way chat completion request."""
+
+    @abc.abstractmethod
+    def chat_completion(self, request: ChatRequest) -> ChatCompletion:
+        """Return ONE ChatCompletion carrying n choices (the n samples)."""
+
+    @abc.abstractmethod
+    def embeddings(self, texts: List[str]) -> List[List[float]]:
+        """Similarity-side-channel embeddings (reference `client.py:75-122`)."""
+
+    def llm_consensus(self, values: List[str]) -> str:
+        """Build a consensus string from candidates (reference
+        `consensus_utils.py:1026-1048` hardcodes gpt-5-mini; local backends answer
+        with their own model). Default: medoid-free fallback to first value."""
+        return values[0]
+
+    def close(self) -> None:  # pragma: no cover - optional
+        pass
+
+
+def resolve_backend(backend: Union[str, Backend, None], **kwargs: Any) -> Backend:
+    """Instantiate a backend from a name ("tpu" | "fake" | "openai") or pass one through."""
+    if isinstance(backend, Backend):
+        return backend
+    name = (backend or "tpu").lower()
+    if name == "fake":
+        from .fake import FakeBackend
+
+        return FakeBackend(**kwargs)
+    if name == "tpu" or name == "jax" or name == "local":
+        from .tpu import TpuBackend
+
+        return TpuBackend(**kwargs)
+    if name == "openai":
+        from .openai_backend import OpenAIBackend
+
+        return OpenAIBackend(**kwargs)
+    raise ValueError(f"Unknown backend {backend!r}; expected 'tpu', 'fake', or 'openai'")

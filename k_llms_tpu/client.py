@@ -1,0 +1,83 @@
+"""Client facade: KLLMs / AsyncKLLMs.
+
+Parity target: `/root/reference/k_llms/client.py` — ``KLLMs`` :31-44,
+``AsyncKLLMs`` :47-60, ``Chat``/``AsyncChat`` :63-72, batched ``get_embeddings``
+helper with token cropping :75-122. The OpenAI client inside becomes a pluggable
+backend: ``KLLMs(backend="tpu", model="llama-3-8b")`` runs everything locally on
+the device mesh; ``backend="fake"`` is the hermetic test double;
+``backend="openai"`` reproduces the reference's HTTP flow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+from .backends.base import Backend, resolve_backend
+from .resources.completions import AsyncCompletions, Completions
+
+# Embedding crop limit kept from the reference (`client.py:12`); the local
+# embedding path enforces the same cap so degradation behavior matches.
+MAX_EMBEDDING_TOKENS = 8191
+
+
+class _BaseKLLMs:
+    def __init__(
+        self,
+        backend: Union[str, Backend, None] = None,
+        model: Optional[str] = None,
+        **backend_kwargs: Any,
+    ):
+        self._backend = resolve_backend(backend, **backend_kwargs)
+        self.default_model = model or "llama-3-8b"
+
+    @property
+    def backend(self) -> Backend:
+        return self._backend
+
+    @property
+    def client(self) -> Backend:
+        """The underlying engine (the reference exposes its OpenAI client here)."""
+        return self._backend
+
+    def get_embeddings(
+        self,
+        texts: List[str],
+        model: str = "local",
+        batch_size: int = 2048,
+        verbose: bool = False,
+    ) -> List[List[float]]:
+        """Batched embeddings helper (reference `client.py:75-122`). Batch-size
+        chunking kept; pricing accounting is moot for a local model."""
+        embeddings: List[List[float]] = []
+        for idx in range(0, len(texts), batch_size):
+            embeddings.extend(self._backend.embeddings(texts[idx : idx + batch_size]))
+        return embeddings
+
+
+class KLLMs(_BaseKLLMs):
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.chat = Chat(self)
+
+
+class AsyncKLLMs(_BaseKLLMs):
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.chat = AsyncChat(self)
+
+    async def aget_embeddings(self, texts: List[str], **kwargs: Any) -> List[List[float]]:
+        import asyncio
+
+        return await asyncio.to_thread(lambda: self.get_embeddings(texts, **kwargs))
+
+
+class Chat:
+    def __init__(self, wrapper: KLLMs):
+        self._wrapper = wrapper
+        self.completions = Completions(wrapper)
+
+
+class AsyncChat:
+    def __init__(self, wrapper: AsyncKLLMs):
+        self._wrapper = wrapper
+        self.completions = AsyncCompletions(wrapper)

@@ -1,0 +1,289 @@
+"""Rebuild a KLLMs(Parsed)ChatCompletion from n samples + consensus.
+
+Parity target: `/root/reference/k_llms/utils/consolidation.py` —
+``_safe_parse_content`` :25-38, ``_format_consensus_content`` :41-60,
+``consolidate_chat_completions`` :63-216 (single-choice passthrough, align,
+consensus, choice rebuild with consensus at index 0 and originals at 1..n),
+``consolidate_parsed_chat_completions`` :306-399 (re-validates the consensus dict
+into the user's Pydantic ``response_format``, silently None on failure :356-365).
+
+The reference's async twins (:219-303, :402-493) duplicate the algorithm line for
+line; here they are ``asyncio.to_thread`` adapters over the one sync core — the
+local TPU engine launches device work once and is internally parallel, so there is
+nothing to interleave per string pair (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional, Type, Union
+
+from pydantic import BaseModel
+
+from ..types import (
+    ChatCompletion,
+    ChatCompletionMessage,
+    Choice,
+    KLLMsChatCompletion,
+    KLLMsParsedChatCompletion,
+    ParsedChatCompletion,
+    ParsedChatCompletionMessage,
+    ParsedChoice,
+)
+from .primitive import LlmConsensusFn
+from .recursion import consensus_values, recursive_list_alignments
+from .settings import ConsensusSettings
+from .similarity import SimilarityScorer
+
+
+def _safe_parse_content(content: str) -> Dict[str, Any]:
+    """Parse content as JSON; wrap free text as {"text": content} on failure."""
+    try:
+        return json.loads(content)
+    except (json.JSONDecodeError, TypeError):
+        return {"text": content}
+
+
+def _format_consensus_content(consensus_content: Optional[Dict[str, Any]]) -> str:
+    """Unwrap the {"text": ...} free-form wrapper; JSON-encode everything else."""
+    if consensus_content is None:
+        return ""
+    if (
+        isinstance(consensus_content, dict)
+        and len(consensus_content) == 1
+        and "text" in consensus_content
+        and isinstance(consensus_content["text"], str)
+    ):
+        return consensus_content["text"]
+    return json.dumps(consensus_content)
+
+
+def _consensus_over_contents(
+    contents: List[Dict[str, Any]],
+    scorer: SimilarityScorer,
+    consensus_settings: ConsensusSettings,
+    llm_consensus_fn: Optional[LlmConsensusFn],
+):
+    """Shared align-then-vote step over parsed choice contents."""
+    if len(contents) >= 2:
+        aligned_seq, _ = recursive_list_alignments(
+            contents,
+            scorer,
+            consensus_settings.min_support_ratio,
+        )
+        contents = [(d if isinstance(d, dict) else {}) for d in aligned_seq]
+    return consensus_values(
+        contents,
+        consensus_settings,
+        scorer,
+        llm_consensus_fn=llm_consensus_fn,
+    )
+
+
+def consolidate_chat_completions(
+    completions: Union[List[ChatCompletion], ChatCompletion],
+    scorer: SimilarityScorer,
+    consensus_settings: ConsensusSettings = ConsensusSettings(),
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> KLLMsChatCompletion:
+    """Consolidate one multi-choice completion (or a list of completions) into a
+    KLLMsChatCompletion: choices[0] = consensus, choices[1..n] = originals."""
+    if isinstance(completions, ChatCompletion):
+        completion = completions
+        assert len(completion.choices) > 0, "Cannot consolidate empty list of choices"
+
+        if len(completion.choices) == 1:
+            return KLLMsChatCompletion.model_validate(completion.model_dump())
+
+        choice_contents: List[Dict[str, Any]] = []
+        for choice in completion.choices:
+            if choice.message.content:
+                choice_contents.append(_safe_parse_content(choice.message.content))
+
+        consensus_content, likelihoods = _consensus_over_contents(
+            choice_contents, scorer, consensus_settings, llm_consensus_fn
+        )
+
+        content_str = _format_consensus_content(consensus_content)
+        consolidated_message = ChatCompletionMessage(
+            role="assistant",
+            content=content_str,
+            function_call=completion.choices[0].message.function_call if completion.choices else None,
+            tool_calls=completion.choices[0].message.tool_calls if completion.choices else None,
+            refusal=completion.choices[0].message.refusal if completion.choices else None,
+        )
+        consolidated_choice = Choice(
+            finish_reason=completion.choices[0].finish_reason if completion.choices else "stop",
+            index=0,
+            message=consolidated_message,
+            logprobs=completion.choices[0].logprobs if completion.choices else None,
+        )
+        individual_choices = [
+            Choice(finish_reason=c.finish_reason, index=i + 1, message=c.message, logprobs=c.logprobs)
+            for i, c in enumerate(completion.choices)
+        ]
+        all_choices = [consolidated_choice] + individual_choices
+
+        return KLLMsChatCompletion.model_validate(
+            {
+                **completion.model_dump(),
+                "choices": [c.model_dump() for c in all_choices],
+                "likelihoods": likelihoods,
+                "usage": completion.usage.model_dump() if completion.usage else None,
+            }
+        )
+
+    # List-of-completions form: one sample per completion's first choice.
+    completion_list = completions
+    assert len(completion_list) > 0, "Cannot consolidate empty list of completions"
+
+    if len(completion_list) == 1:
+        return KLLMsChatCompletion.model_validate(completion_list[0].model_dump())
+
+    completion_contents: List[Dict[str, Any]] = []
+    for completion in completion_list:
+        if completion.choices and completion.choices[0].message.content:
+            completion_contents.append(_safe_parse_content(completion.choices[0].message.content))
+
+    consensus_content, likelihoods = _consensus_over_contents(
+        completion_contents, scorer, consensus_settings, llm_consensus_fn
+    )
+
+    base_completion = completion_list[0]
+    content_str = _format_consensus_content(consensus_content)
+    consolidated_message = ChatCompletionMessage(
+        role="assistant",
+        content=content_str,
+        function_call=base_completion.choices[0].message.function_call if base_completion.choices else None,
+        tool_calls=base_completion.choices[0].message.tool_calls if base_completion.choices else None,
+        refusal=base_completion.choices[0].message.refusal if base_completion.choices else None,
+    )
+    consolidated_choice = Choice(
+        finish_reason=base_completion.choices[0].finish_reason if base_completion.choices else "stop",
+        index=0,
+        message=consolidated_message,
+        logprobs=base_completion.choices[0].logprobs if base_completion.choices else None,
+    )
+    individual_choices = []
+    for i, completion in enumerate(completion_list):
+        if completion.choices:
+            individual_choices.append(
+                Choice(
+                    finish_reason=completion.choices[0].finish_reason,
+                    index=i + 1,
+                    message=completion.choices[0].message,
+                    logprobs=completion.choices[0].logprobs,
+                )
+            )
+    all_choices = [consolidated_choice] + individual_choices
+
+    return KLLMsChatCompletion.model_validate(
+        {
+            **base_completion.model_dump(),
+            "choices": [c.model_dump() for c in all_choices],
+            "likelihoods": likelihoods,
+            "usage": base_completion.usage.model_dump() if base_completion.usage else None,
+        }
+    )
+
+
+def consolidate_parsed_chat_completions(
+    completion: ParsedChatCompletion,
+    scorer: SimilarityScorer,
+    consensus_settings: ConsensusSettings = ConsensusSettings(),
+    response_format: Optional[Type[BaseModel]] = None,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> KLLMsParsedChatCompletion:
+    """Structured-output variant: the consensus dict is re-validated into the
+    user's ``response_format`` model; ``parsed`` is silently None on failure."""
+    assert len(completion.choices) > 0, "Cannot consolidate empty list of choices"
+
+    if len(completion.choices) == 1:
+        return KLLMsParsedChatCompletion.model_validate(completion.model_dump())
+
+    parsed_choice_contents: List[Dict[str, Any]] = []
+    for choice in completion.choices:
+        if choice.message.content:
+            parsed_choice_contents.append(_safe_parse_content(choice.message.content))
+
+    consensus_content, likelihoods = _consensus_over_contents(
+        parsed_choice_contents, scorer, consensus_settings, llm_consensus_fn
+    )
+
+    parsed_consensus = None
+    if response_format and consensus_content is not None:
+        try:
+            if isinstance(response_format, type) and issubclass(response_format, BaseModel):
+                parsed_consensus = response_format.model_validate(consensus_content)
+        except Exception:
+            parsed_consensus = None
+
+    content_str = _format_consensus_content(consensus_content)
+    consolidated_message = ParsedChatCompletionMessage(
+        role="assistant",
+        content=content_str,
+        function_call=completion.choices[0].message.function_call if completion.choices else None,
+        tool_calls=completion.choices[0].message.tool_calls if completion.choices else None,
+        refusal=completion.choices[0].message.refusal if completion.choices else None,
+        parsed=parsed_consensus,
+    )
+    consolidated_choice = ParsedChoice(
+        finish_reason=completion.choices[0].finish_reason if completion.choices else "stop",
+        index=0,
+        message=consolidated_message,
+        logprobs=completion.choices[0].logprobs if completion.choices else None,
+    )
+    individual_choices = [
+        ParsedChoice.model_validate({**c.model_dump(), "index": i + 1})
+        for i, c in enumerate(completion.choices)
+    ]
+    all_choices = [consolidated_choice] + individual_choices
+
+    payload = {
+        **completion.model_dump(),
+        "choices": [c.model_dump() for c in all_choices],
+        "likelihoods": likelihoods,
+        "usage": completion.usage.model_dump() if completion.usage else None,
+    }
+    result = KLLMsParsedChatCompletion.model_validate(payload)
+    # model_dump flattened `parsed` to a dict; restore the validated model object
+    # on the consensus choice (the reference keeps the live object because openai's
+    # ParsedChatCompletion generics re-validate; our vendored generic stores Any).
+    if parsed_consensus is not None:
+        result.choices[0].message.parsed = parsed_consensus
+    return result
+
+
+async def async_consolidate_chat_completions(
+    completion: ChatCompletion,
+    scorer: SimilarityScorer,
+    consensus_settings: ConsensusSettings = ConsensusSettings(),
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> KLLMsChatCompletion:
+    """Async adapter over the sync core (runs in a worker thread)."""
+    return await asyncio.to_thread(
+        consolidate_chat_completions,
+        completion,
+        scorer,
+        consensus_settings,
+        llm_consensus_fn,
+    )
+
+
+async def async_consolidate_parsed_chat_completions(
+    completion: ParsedChatCompletion,
+    scorer: SimilarityScorer,
+    consensus_settings: ConsensusSettings = ConsensusSettings(),
+    response_format: Optional[Type[BaseModel]] = None,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> KLLMsParsedChatCompletion:
+    """Async adapter over the sync core (runs in a worker thread)."""
+    return await asyncio.to_thread(
+        consolidate_parsed_chat_completions,
+        completion,
+        scorer,
+        consensus_settings,
+        response_format,
+        llm_consensus_fn,
+    )

@@ -1,0 +1,209 @@
+"""Primitive (non-enum) consensus: llm-consensus strings, hybrid numeric
+clustering, and the similarity-medoid fallback.
+
+Parity target: ``consensus_as_primitive`` at
+`/root/reference/k_llms/utils/consensus_utils.py:1075-1237`:
+
+- (a) llm-consensus string mode (:1090-1096): ask a model for a consensus string;
+  confidence = mean similarity of candidates to it. The reference hardcodes an
+  OpenAI ``gpt-5-mini`` call (:1026-1048); here the caller supplies
+  ``llm_consensus_fn`` (the TPU backend routes it to the local model).
+- (b) hybrid numeric (:1098-1219): sort, 1-D cluster with rel/abs eps,
+  None-majority rules, tie-break by cross-cluster support including sign-less and
+  power-of-10 closeness; representative = cluster mean.
+- (c) similarity medoid (:1221-1237): full pairwise similarity matrix, pick the
+  row-mean argmax; confidence = that mean.
+
+Every threshold, rounding (5 decimals), and tie-break key is kept bit-compatible.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .settings import ConsensusSettings
+from .similarity import SimilarityScorer
+
+LlmConsensusFn = Callable[[List[str]], str]
+
+
+def consensus_as_primitive(
+    values: list[Any],
+    consensus_settings: ConsensusSettings,
+    scorer: SimilarityScorer,
+    parent_valid_frac: float = 1.0,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> Tuple[Any, float]:
+    non_none_values = [v for v in values if v is not None]
+    if len(non_none_values) == 0:
+        return (None, parent_valid_frac)
+    if len(non_none_values) == 1:
+        return (non_none_values[0], parent_valid_frac * (len(non_none_values) / len(values)))
+
+    first_val_type = type(non_none_values[0])
+
+    # (a) llm-consensus string mode — only with embeddings similarity (:1090).
+    if (
+        first_val_type is str
+        and consensus_settings.string_consensus_method == "llm-consensus"
+        and consensus_settings.string_similarity_method == "embeddings"
+    ):
+        if llm_consensus_fn is None:
+            raise ValueError(
+                "string_consensus_method='llm-consensus' requires an llm_consensus_fn "
+                "(the TPU backend provides one automatically)"
+            )
+        consensus_string = llm_consensus_fn(non_none_values)
+        similarities = [scorer.generic(consensus_string, v) for v in non_none_values]
+        confidence = float(np.nanmean(similarities))
+        return consensus_string, confidence
+
+    # (b) hybrid numeric consensus with None-aware confidence.
+    # NB: `first_val_type()` constructs the type's default instance — for bool that
+    # default is False, which IS an int instance, so all-bool inputs take this
+    # branch and (xs being empty) return (None, parent_valid_frac), exactly like
+    # the reference (:1099-1116).
+    if isinstance(first_val_type(), (int, float)) or all(
+        isinstance(v, (int, float)) for v in non_none_values
+    ):
+        total = len(values)
+        none_count = sum(1 for v in values if v is None)
+        frac_none = none_count / total if total else 0.0
+
+        xs: list[float] = []
+        for v in values:
+            if isinstance(v, bool):
+                continue
+            if isinstance(v, (int, float)):
+                try:
+                    vf = float(v)
+                    if math.isfinite(vf):
+                        xs.append(vf)
+                except Exception:
+                    pass
+        if not xs:
+            return (None, parent_valid_frac)
+
+        xs.sort()
+
+        def _cluster_1d(xs_sorted: list[float]) -> list[list[float]]:
+            if not xs_sorted:
+                return []
+
+            def _is_close(a: float, b: float) -> bool:
+                denom = max(abs(a), abs(b), 1.0)
+                rel_tol = consensus_settings.rel_eps * denom
+                return abs(b - a) <= max(consensus_settings.abs_eps, rel_tol)
+
+            clusters_local: list[list[float]] = []
+            current = [xs_sorted[0]]
+            for i in range(len(xs_sorted) - 1):
+                a, b = xs_sorted[i], xs_sorted[i + 1]
+                if _is_close(a, b):
+                    current.append(b)
+                else:
+                    clusters_local.append(current)
+                    current = [b]
+            clusters_local.append(current)
+            return clusters_local
+
+        rel_eps = consensus_settings.rel_eps
+        abs_eps = consensus_settings.abs_eps
+
+        def _is_close_absrel(a: float, b: float) -> bool:
+            denom = max(abs(a), abs(b), 1.0)
+            return abs(a - b) <= max(abs_eps, rel_eps * denom)
+
+        def _is_close_signless(a: float, b: float) -> bool:
+            return _is_close_absrel(abs(a), abs(b))
+
+        def _is_close_power10(a: float, b: float, k_range: tuple[int, int] = (-6, 6)) -> bool:
+            if a == 0.0 or b == 0.0:
+                return _is_close_absrel(a, b)
+            for k in range(k_range[0], k_range[1] + 1):
+                if _is_close_absrel(a, b * (10.0**k)):
+                    return True
+            return False
+
+        clusters = _cluster_1d(xs)
+        sizes_num = [len(c) for c in clusters]
+        max_size_num = max((len(c) for c in clusters), default=0)
+        sizes_all = sizes_num + ([none_count] if none_count > 0 else [])
+        max_size_all = max(sizes_all) if sizes_all else 0
+
+        if none_count > max_size_num:
+            return (None, round(frac_none, 5))
+
+        if max_size_all > total / 2:
+            if none_count > 0 and none_count == max_size_all:
+                return (None, round(none_count / total, 5))
+            max_idx = int(np.argmax(sizes_num))
+            rep = float(np.mean(clusters[max_idx]))
+            return (rep, round(max_size_all / total, 5))
+
+        if sizes_all.count(max_size_all) == 1:
+            if none_count > 0 and none_count == max_size_all:
+                return (None, round(none_count / total, 5))
+            max_idx = int(np.argmax(sizes_num))
+            rep = float(np.mean(clusters[max_idx]))
+            return (rep, round(max_size_all / total, 5))
+
+        # Tied largest clusters: break by cross-cluster "support" — a candidate
+        # absorbs smaller clusters whose centers are close outright, sign-less
+        # close, or close after a power-of-10 shift (common LLM numeric slips).
+        candidate_indices = [i for i, c in enumerate(clusters) if len(c) == max_size_all]
+        include_none_candidate = none_count > 0 and none_count == max_size_all
+        centers = [float(np.median(c)) if c else float("nan") for c in clusters]
+        spreads = [float(np.std(c)) if len(c) > 1 else 0.0 for c in clusters]
+        supports: list[tuple[str, int, int]] = []
+        for ci in candidate_indices:
+            support = len(clusters[ci])
+            c_center = centers[ci]
+            for oi, other in enumerate(clusters):
+                if oi == ci:
+                    continue
+                if len(other) < len(clusters[ci]):
+                    o_center = centers[oi]
+                    if (
+                        _is_close_absrel(c_center, o_center)
+                        or _is_close_signless(c_center, o_center)
+                        or _is_close_power10(c_center, o_center)
+                    ):
+                        support += len(other)
+            supports.append(("numeric", ci, support))
+        if include_none_candidate:
+            supports.append(("none", -1, none_count))
+        supports.sort(
+            key=lambda t: (
+                -t[2],
+                1 if t[0] != "numeric" else 0,
+                spreads[t[1]] if t[1] >= 0 else float("inf"),
+                -abs(centers[t[1]]) if t[1] >= 0 else 0.0,
+            )
+        )
+        best_kind, best_idx, best_support = supports[0]
+        if best_kind == "none":
+            return (None, round(best_support / total, 5))
+        rep = float(np.mean(clusters[best_idx]))
+        return (rep, round(best_support / total, 5))
+
+    # (c) similarity medoid (strings or other structures).
+    n = len(values)
+    if n == 0:
+        return (None, 0.0)
+    if n == 1:
+        return (values[0], parent_valid_frac)
+    sim_matrix = np.zeros((n, n), dtype=float)
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim = scorer.generic(values[i], values[j])
+            sim_matrix[i, j] = sim_matrix[j, i] = sim
+        sim_matrix[i, i] = np.nan
+    avg_sims = np.nanmean(sim_matrix, axis=1)
+    best_idx = int(np.argmax(avg_sims))
+    best_value = values[best_idx]
+    confidence = parent_valid_frac * float(avg_sims[best_idx])
+    return (best_value, round(confidence, 5))

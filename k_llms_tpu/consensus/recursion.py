@@ -1,0 +1,284 @@
+"""Recursive alignment and the type-directed consensus dispatcher.
+
+Parity targets in `/root/reference/k_llms/utils/consensus_utils.py`:
+``exists_nested_lists`` :433-455, ``recursive_list_alignments`` :458-613 (walks
+dicts per-key and lists per-position, returning aligned values plus key-mapping
+paths back to original source positions), ``consensus_dict`` :1269-1306,
+``consensus_list`` :1309-1352, and the dispatcher ``consensus_values`` :1376-1454
+(str/bool with every value under 3 words => voting; dict => field recursion with
+``parent_valid_frac`` scaled by the dict-typed fraction; list => element-wise
+recursion; else primitive consensus).
+
+Signature change vs the reference: similarity flows through a
+:class:`SimilarityScorer` (and optional ``llm_consensus_fn``) rather than an
+OpenAI-embeddings callback plus client.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .alignment import lists_alignment
+from .primitive import LlmConsensusFn, consensus_as_primitive
+from .settings import SPECIAL_FIELD_PREFIXES, ConsensusSettings
+from .similarity import SimilarityScorer
+from .voting import voting_consensus
+
+
+def exists_nested_lists(values: List[Any]) -> bool:
+    """True if any value is a list, or a dict containing nested lists."""
+    if not values:
+        return False
+    for v in values:
+        if isinstance(v, list):
+            return True
+        elif isinstance(v, dict):
+            if exists_nested_lists(list(v.values())):
+                return True
+    return False
+
+
+def recursive_list_alignments(
+    values: List[Any],
+    scorer: SimilarityScorer,
+    min_support_ratio: float,
+    max_novelty_ratio: float = 0.25,
+    current_path: str = "",
+    reference_idx: Optional[int] = None,
+) -> Tuple[List[Any], Dict[str, List[Optional[str]]]]:
+    """Recursively align nested dicts/lists across the n samples.
+
+    Returns the aligned values (same outer structure) and a mapping from each
+    aligned path to, per sample, the original source path that landed there (or
+    None where a sample contributed nothing).
+    """
+    if not values:
+        return values, {}
+
+    if all(v is None for v in values):
+        return values, {current_path: [current_path for _ in values]}
+
+    non_nulls = [v for v in values if v is not None]
+
+    # Defensive copy: alignment mutates the nested structure in place.
+    values = deepcopy(values)
+
+    first_type = type(non_nulls[0])
+    same_type = all(isinstance(x, first_type) for x in non_nulls)
+    key_mappings: Dict[str, List[Optional[str]]] = {}
+
+    if not same_type or first_type not in (dict, list):
+        key_mappings[current_path] = [
+            current_path if (v is not None or idx == reference_idx) else None
+            for idx, v in enumerate(values)
+        ]
+        return values, key_mappings
+
+    if first_type is dict:
+        dicts_only = [(d if isinstance(d, dict) else {}) for d in values]
+
+        all_keys = list(set(k for d in dicts_only for k in d.keys()))
+        all_keys.sort()
+
+        for key in all_keys:
+            values_for_key = [d.get(key) for d in dicts_only]
+            _current_path = f"{current_path}.{key}" if current_path else key
+            aligned_values_for_key, sub_key_mapping = recursive_list_alignments(
+                values_for_key,
+                scorer,
+                min_support_ratio,
+                max_novelty_ratio=max_novelty_ratio,
+                current_path=_current_path,
+                reference_idx=reference_idx,
+            )
+            for _d, aligned_value in zip(dicts_only, aligned_values_for_key):
+                _d[key] = aligned_value
+            key_mappings.update(sub_key_mapping)
+
+        values = [{k: _d.get(k) for k in all_keys} for _d in dicts_only]
+
+    if first_type is list:
+        lists_only = [(lst if isinstance(lst, list) else []) for lst in values]
+        original_list_reference_indices: List[List[Optional[int]]] = [
+            [None for _ in lst] for lst in lists_only
+        ]
+
+        if any(lst for lst in lists_only):
+            aligned_lists_only, original_list_reference_indices = lists_alignment(
+                lists_only,
+                scorer.generic,
+                min_support_ratio=min_support_ratio,
+                max_novelty_ratio=max_novelty_ratio,
+                reference_list_idx=reference_idx,
+            )
+            for l_idx, new_lst in enumerate(aligned_lists_only):
+                values[l_idx] = new_lst
+        else:
+            for i in range(len(values)):
+                values[i] = []
+
+        if len(values) > 0:
+            list_length = len(values[0])
+            if list_length > 0:
+                for i in range(list_length):
+                    values_i = [lst[i] for lst in values]
+                    values_i, sub_key_mapping = recursive_list_alignments(
+                        values_i,
+                        scorer,
+                        min_support_ratio,
+                        max_novelty_ratio=max_novelty_ratio,
+                        current_path="",
+                        reference_idx=reference_idx,
+                    )
+                    for l_idx, new_lst in enumerate(values_i):
+                        values[l_idx][i] = new_lst
+
+                    # Rewrite sub-paths through the original positions so the
+                    # mapping points at where each value came from pre-alignment.
+                    for key, sub_values in sub_key_mapping.items():
+                        _key_path = f"{current_path}.{i}" if current_path else str(i)
+                        _key_path = f"{_key_path}.{key}" if key else _key_path
+                        current_values: List[Optional[str]] = []
+                        for l_idx, v in enumerate(sub_values):
+                            _original_position = original_list_reference_indices[l_idx][i]
+                            if _original_position is None or v is None:
+                                current_values.append(None)
+                            else:
+                                _original_value_path = (
+                                    f"{current_path}.{_original_position}"
+                                    if current_path
+                                    else _original_position
+                                )
+                                _original_value_path = (
+                                    f"{_original_value_path}.{v}" if v else _original_value_path
+                                )
+                                current_values.append(_original_value_path)
+                        key_mappings[_key_path] = current_values
+            elif current_path:  # don't support empty root paths
+                key_mappings[current_path] = [current_path] * len(values)
+
+    return values, key_mappings
+
+
+def consensus_dict(
+    dict_values: List[dict],
+    consensus_settings: ConsensusSettings,
+    scorer: SimilarityScorer,
+    parent_valid_frac: float = 1.0,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> Tuple[dict, Dict[str, Any]]:
+    """Field-by-field consensus. Returns (merged_dict, per-field confidences)."""
+    seen: set = set()
+    all_keys = [k for d in dict_values for k in d.keys() if k not in seen and not seen.add(k)]
+
+    result: dict = {}
+    confs: Dict[str, Any] = {}
+
+    for key in all_keys:
+        # reasoning___/source___ fields are skipped entirely (:1287-1294).
+        if any(prefix in key for prefix in SPECIAL_FIELD_PREFIXES):
+            continue
+        sub_vals = [d.get(key, None) for d in dict_values]
+        val, conf = consensus_values(
+            sub_vals,
+            consensus_settings,
+            scorer,
+            parent_valid_frac=parent_valid_frac,
+            llm_consensus_fn=llm_consensus_fn,
+        )
+        result[key] = val
+        confs[key] = conf
+
+    return (result, confs)
+
+
+def consensus_list(
+    list_values: List[List[Any]],
+    consensus_settings: ConsensusSettings,
+    scorer: SimilarityScorer,
+    parent_valid_frac: float = 1.0,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> Tuple[List[Any], List[Any]]:
+    """Element-wise consensus across aligned lists (position i votes with position i)."""
+    if not list_values:
+        return ([], [])
+
+    non_empty_list_values = [lst for lst in list_values if lst]
+    if not non_empty_list_values:
+        return ([], [])
+
+    lengths = [len(lst) for lst in list_values]
+    maximum_len = max(lengths)
+    if maximum_len == 0:
+        return ([], [])
+
+    final_list = []
+    confidences = []
+    for i in range(maximum_len):
+        items = [(model_list[i] if i < len(model_list) else None) for model_list in list_values]
+        val_i, conf_i = consensus_values(
+            items,
+            consensus_settings,
+            scorer,
+            parent_valid_frac=parent_valid_frac,
+            llm_consensus_fn=llm_consensus_fn,
+        )
+        final_list.append(val_i)
+        confidences.append(conf_i)
+
+    return final_list, confidences
+
+
+def consensus_values(
+    values: List[Any],
+    consensus_settings: ConsensusSettings,
+    scorer: SimilarityScorer,
+    parent_valid_frac: float = 1.0,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+) -> Tuple[Any, Union[float, List[Any], Dict[str, Any]]]:
+    """Type-directed consensus dispatcher. Returns (value, confidence-structure)."""
+    if not values:
+        return (None, parent_valid_frac)
+
+    non_none_values = [v for v in values if v is not None]
+    if not non_none_values:
+        return (None, 0.0)
+
+    # Enum-like str/bool (every value under 3 words) => voting.
+    if isinstance(non_none_values[0], (str, bool)):
+        values_as_strings = [str(v).strip() for v in non_none_values]
+        is_enum_like = all(len(v.split()) < 3 for v in values_as_strings)
+        if is_enum_like:
+            return voting_consensus(values, consensus_settings, parent_valid_frac=parent_valid_frac)
+
+    if isinstance(non_none_values[0], dict):
+        dicts_only = [v for v in values if isinstance(v, dict)]
+        parent_valid_frac *= len(dicts_only) / len(values)
+        return consensus_dict(
+            dicts_only,
+            consensus_settings,
+            scorer,
+            parent_valid_frac=parent_valid_frac,
+            llm_consensus_fn=llm_consensus_fn,
+        )
+
+    if isinstance(non_none_values[0], list):
+        lists_only = [v for v in values if isinstance(v, list)]
+        parent_valid_frac *= len(lists_only) / len(values)
+        return consensus_list(
+            lists_only,
+            consensus_settings,
+            scorer,
+            parent_valid_frac=parent_valid_frac,
+            llm_consensus_fn=llm_consensus_fn,
+        )
+
+    parent_valid_frac *= len(non_none_values) / len(values)
+    return consensus_as_primitive(
+        non_none_values,
+        consensus_settings,
+        scorer,
+        parent_valid_frac=parent_valid_frac,
+        llm_consensus_fn=llm_consensus_fn,
+    )

@@ -1,0 +1,48 @@
+"""Consensus knobs.
+
+Parity target: ``ConsensusSettings`` at
+`/root/reference/k_llms/utils/consensus_utils.py:53-69`. Every default here is
+load-bearing — the dynamic alignment threshold, numeric clustering, and vote
+thresholds are tuned around them (SURVEY.md §2.2).
+"""
+
+from typing import Literal
+
+from pydantic import BaseModel
+
+StringSimilarityMethod = Literal["levenshtein", "jaccard", "hamming", "embeddings"]
+StringConsensusMethod = Literal["centroid", "llm-consensus"]
+
+# Floor used everywhere a similarity must stay strictly positive
+# (reference `consensus_utils.py:78`).
+SIMILARITY_SCORE_LOWER_BOUND = 1e-8
+
+# Keys matched by these regexes are skipped during dict similarity
+# (reference `consensus_utils.py:38-43`; matching is `re.match`, i.e. anchored at
+# the start of the key).
+IGNORED_KEY_PATTERNS = [
+    r"reasoning___",
+    r"source___",
+]
+
+# Prefixes skipped entirely during dict consensus (reference
+# `consensus_utils.py:1287`; matching is substring containment there).
+SPECIAL_FIELD_PREFIXES = ["reasoning___", "source___"]
+
+
+class ConsensusSettings(BaseModel):
+    allow_none_as_candidate: bool = False
+    # String-specific settings
+    string_similarity_method: StringSimilarityMethod = "embeddings"
+    string_consensus_method: StringConsensusMethod = "centroid"
+    # Align objects with a minimum similarity threshold
+    minimum_voters_threshold: float = 0.75
+    min_support_ratio: float = 0.51  # at least 51% of the voters must agree
+    # Numeric consensus parameters (hybrid vote-or-mean)
+    rel_eps: float = 0.03  # relative closeness (e.g. 3%)
+    abs_eps: float = 1e-6  # absolute closeness to protect near zero
+    # Majority threshold for voting (slightly easier for small n if maj_loosen_k>0)
+    base_maj_thresh: float = 0.6
+    maj_loosen_k: float = 0.1
+    # Robust mean (used only when n >= 5)
+    trim_frac: float = 0.2

@@ -1,0 +1,174 @@
+"""Generic value similarity with a pluggable embedding provider.
+
+Parity targets in `/root/reference/k_llms/utils/consensus_utils.py`:
+``_cosine_similarity`` :626-649, ``string_similarity`` :797-824 (TTL-cached,
+embeddings gated on both strings > 50 chars, Levenshtein fallback on any failure),
+``numerical_similarity`` :827-841, ``dict_similarity`` :844-869 (skips
+reasoning___/source___ keys), ``list_similarity`` :872-889 (positional mean),
+``generic_similarity`` :892-917 (both-falsy => 1.0, single None => 1e-8 floor).
+
+Design change vs the reference: instead of threading a raw
+``sync_get_openai_embeddings_from_text`` callable through every function, similarity
+state (method + embedding provider + caches) lives in one :class:`SimilarityScorer`.
+The TPU backend plugs in on-device mean-pooled hidden-state embeddings; tests plug
+in deterministic fakes. The reference's module-global TTL caches become per-scorer
+(same 1024/300s policy, thread-safe).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import re
+from typing import Any, Callable, List, Optional, Protocol
+
+import numpy as np
+
+from .cache import TTLCache
+from .settings import (
+    IGNORED_KEY_PATTERNS,
+    SIMILARITY_SCORE_LOWER_BOUND,
+    StringSimilarityMethod,
+)
+from .text import hamming_similarity, jaccard_similarity, levenshtein_similarity
+
+logger = logging.getLogger(__name__)
+
+EmbeddingFn = Callable[[List[str]], List[List[float]]]
+
+NumericalPrimitive = (int, float)
+
+# Embeddings are only worth the trip for long strings (reference :813).
+EMBEDDING_MIN_CHARS = 50
+
+
+def cosine_similarity(vec1: List[float], vec2: List[float]) -> float:
+    """Cosine similarity normalized from [-1,1] to [0,1] and floored at 1e-8."""
+    arr1 = np.asarray(vec1, dtype=np.float64)
+    arr2 = np.asarray(vec2, dtype=np.float64)
+    if arr1.shape != arr2.shape:
+        raise ValueError("Vectors must have the same shape for cosine similarity")
+    norm1 = np.linalg.norm(arr1)
+    norm2 = np.linalg.norm(arr2)
+    if norm1 == 0 or norm2 == 0:
+        return SIMILARITY_SCORE_LOWER_BOUND
+    similarity = float(np.dot(arr1, arr2) / (norm1 * norm2))
+    similarity = 0.5 * (similarity + 1.0)
+    return float(np.clip(similarity, SIMILARITY_SCORE_LOWER_BOUND, 1.0))
+
+
+def numerical_similarity(val1: Any, val2: Any) -> float:
+    """Booleans exact; numbers within 1% relative tolerance; else equality."""
+    if isinstance(val1, bool) and isinstance(val2, bool):
+        return 1.0 if val1 == val2 else SIMILARITY_SCORE_LOWER_BOUND
+    if (
+        isinstance(val1, NumericalPrimitive)
+        and isinstance(val2, NumericalPrimitive)
+        and math.isclose(val1, val2, rel_tol=0.01)
+    ):
+        return 1.0
+    return 1.0 if val1 == val2 else SIMILARITY_SCORE_LOWER_BOUND
+
+
+class EmbeddingProvider(Protocol):
+    def __call__(self, texts: List[str]) -> List[List[float]]: ...
+
+
+class SimilarityScorer:
+    """Stateful similarity engine: method dispatch + embedding provider + caches."""
+
+    def __init__(
+        self,
+        method: StringSimilarityMethod = "embeddings",
+        embed_fn: Optional[EmbeddingFn] = None,
+        cache_maxsize: int = 1024,
+        cache_ttl: float = 300.0,
+    ):
+        self.method = method
+        self.embed_fn = embed_fn
+        self._sim_cache = TTLCache(maxsize=cache_maxsize, ttl=cache_ttl)
+        self._emb_cache = TTLCache(maxsize=cache_maxsize, ttl=cache_ttl)
+
+    # -- embeddings -------------------------------------------------------
+    def get_embedding(self, s: str) -> List[float]:
+        cached = self._emb_cache.get(s)
+        if cached is not None:
+            return cached
+        if self.embed_fn is None:
+            raise RuntimeError("No embedding provider configured")
+        result = self.embed_fn([s])[0]
+        self._emb_cache.set(s, result)
+        return result
+
+    # -- strings ----------------------------------------------------------
+    def string(self, s1: str, s2: str) -> float:
+        key = (min(s1, s2), max(s1, s2), self.method)
+        cached = self._sim_cache.get(key)
+        if cached is not None:
+            return cached
+        result: Optional[float] = None
+        if self.method == "jaccard":
+            result = jaccard_similarity(s1, s2)
+        elif self.method == "hamming":
+            result = hamming_similarity(s1, s2)
+        elif (
+            self.method == "embeddings"
+            and len(s1) > EMBEDDING_MIN_CHARS
+            and len(s2) > EMBEDDING_MIN_CHARS
+            and self.embed_fn is not None
+        ):
+            try:
+                result = cosine_similarity(self.get_embedding(s1), self.get_embedding(s2))
+            except Exception as e:  # degrade identically to the reference (:816-817)
+                logger.error("Error getting embeddings for %r and %r", s1, s2, exc_info=e)
+        if result is None:
+            result = levenshtein_similarity(s1, s2)
+        self._sim_cache.set(key, result)
+        return result
+
+    # -- containers -------------------------------------------------------
+    def dict(self, d1: dict, d2: dict) -> float:
+        all_keys = set(d1.keys()) | set(d2.keys())
+        all_keys = [
+            k for k in all_keys if not any(re.match(p, k) for p in IGNORED_KEY_PATTERNS)
+        ]
+        if not all_keys:
+            return 1.0
+        total = 0.0
+        for k in all_keys:
+            total += self.generic(d1.get(k), d2.get(k))
+        return total / len(all_keys)
+
+    def list(self, l1, l2) -> float:
+        max_len = max(len(l1), len(l2))
+        if max_len == 0:
+            return 1.0
+        total = 0.0
+        for i in range(max_len):
+            v1 = l1[i] if i < len(l1) else None
+            v2 = l2[i] if i < len(l2) else None
+            total += self.generic(v1, v2)
+        return total / max_len
+
+    # -- dispatcher -------------------------------------------------------
+    def generic(self, v1: Any, v2: Any) -> float:
+        # Both falsy ("" / 0 / [] / False / None) => perfect agreement.
+        if not bool(v1) and not bool(v2):
+            return 1.0
+        if v1 is None or v2 is None:
+            return SIMILARITY_SCORE_LOWER_BOUND
+        if isinstance(v1, str) and isinstance(v2, str):
+            return self.string(v1, v2)
+        elif isinstance(v1, NumericalPrimitive) and isinstance(v2, NumericalPrimitive):
+            return numerical_similarity(v1, v2)
+        elif isinstance(v1, dict) and isinstance(v2, dict):
+            return self.dict(v1, v2)
+        elif isinstance(v1, (list, tuple)) and isinstance(v2, (list, tuple)):
+            return self.list(v1, v2)
+        else:
+            return SIMILARITY_SCORE_LOWER_BOUND
+
+    # Convenience constructor used by tests and the alignment internals.
+    @classmethod
+    def levenshtein(cls) -> "SimilarityScorer":
+        return cls(method="levenshtein")

@@ -1,0 +1,51 @@
+"""Vote-based (enum-like) consensus.
+
+Parity target: ``voting_consensus`` at
+`/root/reference/k_llms/utils/consensus_utils.py:936-982`. Most-common non-null
+value wins; booleans treat None as False; strings vote under their sanitized form
+but the winner is reported in its original spelling (first occurrence). Confidence
+is ``parent_valid_frac * count/total`` rounded to 5 decimals.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional, Tuple, Union
+
+from .settings import ConsensusSettings
+from .text import sanitize_value
+
+__all__ = ["voting_consensus", "sanitize_value"]
+
+
+def voting_consensus(
+    values: list[Union[str, bool, None]],
+    consensus_settings: ConsensusSettings,
+    parent_valid_frac: float = 1.0,
+) -> Tuple[Optional[Union[str, bool]], float]:
+    total_values = len(values)
+
+    if not any(v is not None for v in values):
+        return (None, parent_valid_frac)
+
+    first_non_none = next((v for v in values if v is not None), None)
+    is_boolean = isinstance(first_non_none, bool)
+
+    if is_boolean:
+        # For booleans: treat None as False.
+        processed_values = [v or False for v in values]
+        counts = Counter(processed_values)
+        best_val, best_count = counts.most_common(1)[0]
+    else:
+        if consensus_settings.allow_none_as_candidate:
+            valid_values = values
+        else:
+            valid_values = [v for v in values if v is not None]
+        processed_values = [(sanitize_value(v) if v is not None else None) for v in valid_values]
+        counts = Counter(processed_values)
+        best_normalized, best_count = counts.most_common(1)[0]
+        # Report the winner in its original (first-seen) spelling.
+        best_val = valid_values[processed_values.index(best_normalized)]
+
+    confidence = parent_valid_frac * (best_count / total_values)
+    return (best_val, round(confidence, 5))

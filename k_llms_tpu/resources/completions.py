@@ -1,0 +1,147 @@
+"""Completions resource: the public create/parse API surface.
+
+Parity target: `/root/reference/k_llms/resources/completions/completions.py` —
+same keyword signatures, streaming forced off (:36, :173-174), native ``n``
+passed to ONE model call (:70-73), consolidation on the multi-choice result.
+The model call goes to a pluggable :class:`Backend` instead of the OpenAI HTTP
+client, and the per-call embeddings closure (:67-68) becomes the backend's
+embedding provider wired into a :class:`SimilarityScorer`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import TYPE_CHECKING, Any, List, Optional, Type, Union
+
+from pydantic import BaseModel
+
+from ..backends.base import ChatRequest
+from ..consensus.consolidation import (
+    consolidate_chat_completions,
+    consolidate_parsed_chat_completions,
+)
+from ..consensus.settings import ConsensusSettings
+from ..consensus.similarity import SimilarityScorer
+from ..types import KLLMsChatCompletion, KLLMsParsedChatCompletion
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..client import AsyncKLLMs, KLLMs
+
+
+def _build_request(
+    messages: List[dict],
+    model: str,
+    n: Optional[int],
+    temperature: Optional[float],
+    max_tokens: Optional[int],
+    top_p: Optional[float],
+    frequency_penalty: Optional[float],
+    presence_penalty: Optional[float],
+    stop: Optional[Union[str, List[str]]],
+    seed: Optional[int],
+    response_format: Optional[Any],
+    kwargs: dict,
+) -> ChatRequest:
+    kwargs = dict(kwargs)
+    kwargs.pop("stream", None)  # streaming unsupported, like the reference (:36)
+    return ChatRequest(
+        messages=messages,
+        model=model,
+        n=n or 1,
+        temperature=temperature,
+        max_tokens=max_tokens,
+        top_p=top_p,
+        frequency_penalty=frequency_penalty,
+        presence_penalty=presence_penalty,
+        stop=stop,
+        seed=seed,
+        response_format=response_format,
+        extra=kwargs,
+    )
+
+
+class Completions:
+    def __init__(self, wrapper: "KLLMs"):
+        self._wrapper = wrapper
+
+    def _scorer(self, settings: ConsensusSettings) -> SimilarityScorer:
+        return SimilarityScorer(
+            method=settings.string_similarity_method,
+            embed_fn=self._wrapper.backend.embeddings,
+        )
+
+    def create(
+        self,
+        *,
+        messages: List[dict],
+        model: Optional[str] = None,
+        n: Optional[int] = None,
+        temperature: Optional[float] = None,
+        max_tokens: Optional[int] = None,
+        top_p: Optional[float] = None,
+        frequency_penalty: Optional[float] = None,
+        presence_penalty: Optional[float] = None,
+        stop: Optional[Union[str, List[str]]] = None,
+        seed: Optional[int] = None,
+        response_format: Optional[Any] = None,
+        consensus_settings: Optional[ConsensusSettings] = None,
+        **kwargs: Any,
+    ) -> KLLMsChatCompletion:
+        settings = consensus_settings or ConsensusSettings()
+        request = _build_request(
+            messages, model or self._wrapper.default_model, n, temperature, max_tokens,
+            top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
+        )
+        completion = self._wrapper.backend.chat_completion(request)
+        return consolidate_chat_completions(
+            completion,
+            self._scorer(settings),
+            consensus_settings=settings,
+            llm_consensus_fn=self._wrapper.backend.llm_consensus,
+        )
+
+    def parse(
+        self,
+        *,
+        messages: List[dict],
+        response_format: Type[BaseModel],
+        model: Optional[str] = None,
+        n: Optional[int] = None,
+        temperature: Optional[float] = None,
+        max_tokens: Optional[int] = None,
+        top_p: Optional[float] = None,
+        frequency_penalty: Optional[float] = None,
+        presence_penalty: Optional[float] = None,
+        stop: Optional[Union[str, List[str]]] = None,
+        seed: Optional[int] = None,
+        consensus_settings: Optional[ConsensusSettings] = None,
+        **kwargs: Any,
+    ) -> KLLMsParsedChatCompletion:
+        settings = consensus_settings or ConsensusSettings()
+        request = _build_request(
+            messages, model or self._wrapper.default_model, n, temperature, max_tokens,
+            top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
+        )
+        completion = self._wrapper.backend.chat_completion(request)
+        return consolidate_parsed_chat_completions(
+            completion,
+            self._scorer(settings),
+            consensus_settings=settings,
+            response_format=response_format,
+            llm_consensus_fn=self._wrapper.backend.llm_consensus,
+        )
+
+
+class AsyncCompletions:
+    """Async frontend over the same core; device work is internally parallel, so
+    the reference's full async mirror collapses into thread-offloaded adapters."""
+
+    def __init__(self, wrapper: "AsyncKLLMs"):
+        self._wrapper = wrapper
+        self._sync = Completions(wrapper)  # type: ignore[arg-type]
+
+    async def create(self, **kwargs: Any) -> KLLMsChatCompletion:
+        return await asyncio.to_thread(lambda: self._sync.create(**kwargs))
+
+    async def parse(self, **kwargs: Any) -> KLLMsParsedChatCompletion:
+        return await asyncio.to_thread(lambda: self._sync.parse(**kwargs))

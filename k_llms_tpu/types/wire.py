@@ -1,0 +1,128 @@
+"""Vendored OpenAI-compatible chat-completion wire types.
+
+The reference (k-LLMs) subclasses pydantic models from the ``openai`` package
+(`/root/reference/k_llms/types/completions.py:7`, `parsed.py:7`) and so depends on it
+for types only. On TPU hosts we must run with zero OpenAI dependency, so this module
+vendors a minimal-but-faithful pydantic replica of the wire types the reference's
+surface uses: ``ChatCompletion``, ``Choice``, ``ChatCompletionMessage``,
+``CompletionUsage`` (with token-detail subobjects), the logprob containers, and the
+``Parsed*`` generics. Field names, defaults, and JSON layout match the OpenAI SDK so
+serialized payloads are drop-in compatible.
+
+If the real ``openai`` package is installed, ``k_llms_tpu.types`` prefers it (see
+``k_llms_tpu/types/__init__.py``) — these models are the fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Generic, List, Literal, Optional, TypeVar
+
+from pydantic import BaseModel, ConfigDict
+
+
+class _Model(BaseModel):
+    """Base config mirroring openai._models.BaseModel: tolerate unknown fields."""
+
+    model_config = ConfigDict(extra="allow")
+
+
+class FunctionCall(_Model):
+    arguments: str
+    name: str
+
+
+class Function(_Model):
+    arguments: str
+    name: str
+
+
+class ChatCompletionMessageToolCall(_Model):
+    id: str
+    function: Function
+    type: Literal["function"] = "function"
+
+
+class TopLogprob(_Model):
+    token: str
+    bytes: Optional[List[int]] = None
+    logprob: float
+
+
+class ChatCompletionTokenLogprob(_Model):
+    token: str
+    bytes: Optional[List[int]] = None
+    logprob: float
+    top_logprobs: List[TopLogprob] = []
+
+
+class ChoiceLogprobs(_Model):
+    content: Optional[List[ChatCompletionTokenLogprob]] = None
+    refusal: Optional[List[ChatCompletionTokenLogprob]] = None
+
+
+class ChatCompletionMessage(_Model):
+    content: Optional[str] = None
+    refusal: Optional[str] = None
+    role: Literal["assistant"] = "assistant"
+    function_call: Optional[FunctionCall] = None
+    tool_calls: Optional[List[ChatCompletionMessageToolCall]] = None
+
+
+FinishReason = Literal["stop", "length", "tool_calls", "content_filter", "function_call"]
+
+
+class Choice(_Model):
+    finish_reason: FinishReason
+    index: int
+    logprobs: Optional[ChoiceLogprobs] = None
+    message: ChatCompletionMessage
+
+
+class PromptTokensDetails(_Model):
+    audio_tokens: Optional[int] = None
+    cached_tokens: Optional[int] = None
+
+
+class CompletionTokensDetails(_Model):
+    accepted_prediction_tokens: Optional[int] = None
+    audio_tokens: Optional[int] = None
+    reasoning_tokens: Optional[int] = None
+    rejected_prediction_tokens: Optional[int] = None
+
+
+class CompletionUsage(_Model):
+    completion_tokens: int
+    prompt_tokens: int
+    total_tokens: int
+    completion_tokens_details: Optional[CompletionTokensDetails] = None
+    prompt_tokens_details: Optional[PromptTokensDetails] = None
+
+
+class ChatCompletion(_Model):
+    id: str
+    choices: List[Choice]
+    created: int
+    model: str
+    object: Literal["chat.completion"] = "chat.completion"
+    service_tier: Optional[str] = None
+    system_fingerprint: Optional[str] = None
+    usage: Optional[CompletionUsage] = None
+
+
+ContentType = TypeVar("ContentType")
+
+
+class ParsedChatCompletionMessage(ChatCompletionMessage, Generic[ContentType]):
+    parsed: Optional[ContentType] = None
+
+
+class ParsedChoice(Choice, Generic[ContentType]):
+    message: ParsedChatCompletionMessage[ContentType]
+
+
+class ParsedChatCompletion(ChatCompletion, Generic[ContentType]):
+    choices: List[ParsedChoice[ContentType]]  # type: ignore[assignment]
+
+
+# Request-side aliases (the reference types these loosely; we accept plain dicts)
+ChatCompletionMessageParam = Dict[str, Any]

@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU platform before JAX initializes.
+
+The reference (k-LLMs) has no hermetic test story (SURVEY.md §4); ours runs the whole
+framework — including the "distributed" decode path — on a simulated 8-device CPU mesh
+so no TPU hardware is needed for CI.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,122 @@
+"""List alignment pipeline + Condorcet ordering
+(reference consensus_utils :109-430, majority_sorting.py)."""
+
+import pytest
+
+from k_llms_tpu.consensus.alignment import (
+    _compute_dynamic_threshold,
+    _prune_low_support_elements,
+    SimilarityCache,
+    lists_alignment,
+    low_cutoff_bound,
+    remove_outliers,
+)
+from k_llms_tpu.consensus.majority import sort_by_original_majority
+from k_llms_tpu.consensus.recursion import recursive_list_alignments
+from k_llms_tpu.consensus.similarity import SimilarityScorer
+
+
+@pytest.fixture
+def scorer():
+    return SimilarityScorer(method="levenshtein")
+
+
+def test_prune_low_support():
+    aligned = [["a", None], ["a", None], ["a", "b"]]
+    pruned = _prune_low_support_elements(aligned, 0.51)
+    assert pruned == [["a"], ["a"], ["a"]]
+
+
+def test_prune_relaxes_when_all_below():
+    aligned = [["a", None], [None, "b"], [None, None]]
+    pruned = _prune_low_support_elements(aligned, 0.9)
+    # max support is 1/3; threshold relaxes to that, both columns kept
+    assert pruned == aligned
+
+
+def test_low_cutoff_bound_empty():
+    assert low_cutoff_bound([]) == 0.0
+
+
+def test_remove_outliers_no_jump():
+    data = [0.5, 0.51, 0.52, 0.53, 0.9]
+    assert remove_outliers(data) == data
+
+
+def test_dynamic_threshold_single_list(scorer):
+    cache = SimilarityCache(scorer.generic, [["a"]])
+    assert _compute_dynamic_threshold(cache) == 0.5
+
+
+def test_alignment_identical_lists(scorer):
+    lists = [["apple", "banana"], ["apple", "banana"], ["apple", "banana"]]
+    aligned, idx = lists_alignment(lists, scorer.generic, min_support_ratio=0.51)
+    assert aligned == [["apple", "banana"]] * 3
+    assert idx == [[0, 1]] * 3
+
+
+def test_alignment_permuted_lists(scorer):
+    lists = [["apple pie", "banana bread"], ["banana bread", "apple pie"]]
+    aligned, idx = lists_alignment(lists, scorer.generic, min_support_ratio=0.5)
+    # Same column contents across rows after alignment
+    for col in range(2):
+        vals = {row[col] for row in aligned}
+        assert len(vals) == 1
+    # Condorcet order follows majority original order: tie 1-1, broken by avg pos
+    flat = aligned[0]
+    assert set(flat) == {"apple pie", "banana bread"}
+
+
+def test_alignment_missing_element_gives_none(scorer):
+    lists = [["apple pie", "banana bread"], ["apple pie"], ["apple pie", "banana bread"]]
+    aligned, _ = lists_alignment(lists, scorer.generic, min_support_ratio=0.5)
+    assert aligned[1] == ["apple pie", None]
+
+
+def test_alignment_empty_lists(scorer):
+    aligned, idx = lists_alignment([[], []], scorer.generic)
+    assert aligned == [[], []]
+
+
+def test_alignment_with_known_reference(scorer):
+    lists = [["x1", "y1"], ["y1", "x1"]]
+    aligned, idx = lists_alignment(lists, scorer.generic, reference_list_idx=0)
+    assert aligned[0] == ["x1", "y1"]
+    assert aligned[1] == ["x1", "y1"]
+    assert idx[1] == [1, 0]
+
+
+def test_sort_by_original_majority_reorders():
+    originals = [["b", "a"], ["b", "a"], ["a", "b"]]
+    # aligned columns: col0 = a's, col1 = b's (same objects)
+    aligned = [[row[1], row[0]] for row in originals[:2]] + [[originals[2][0], originals[2][1]]]
+    sorted_lists, pos = sort_by_original_majority(aligned, originals)
+    # b precedes a in 2 of 3 rows => b's column first
+    assert sorted_lists[0] == ["b", "a"]
+    assert pos[0] == [0, 1]
+
+
+def test_recursive_alignment_dicts_of_lists(scorer):
+    values = [
+        {"items": [{"name": "alpha beta"}, {"name": "gamma delta"}]},
+        {"items": [{"name": "gamma delta"}, {"name": "alpha beta"}]},
+    ]
+    aligned, mappings = recursive_list_alignments(values, scorer, 0.51)
+    names0 = [d["name"] for d in aligned[0]["items"]]
+    names1 = [d["name"] for d in aligned[1]["items"]]
+    assert names0 == names1
+    assert any(k.startswith("items.") for k in mappings)
+
+
+def test_recursive_alignment_preserves_all_none():
+    values = [None, None]
+    aligned, mappings = recursive_list_alignments(values, SimilarityScorer.levenshtein(), 0.51)
+    assert aligned == [None, None]
+    assert mappings == {"": ["", ""]}
+
+
+def test_recursive_alignment_mixed_types_passthrough(scorer):
+    values = [{"a": 1}, "string", 5]
+    aligned, mappings = recursive_list_alignments(values, scorer, 0.51, current_path="root")
+    assert aligned == values
+    assert mappings == {"root": ["root", "root", "root"]}

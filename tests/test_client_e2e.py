@@ -1,0 +1,131 @@
+"""End-to-end client tests on the fake backend (the hermetic substitute for the
+reference's live-API suite, README_TESTS.md:100-111)."""
+
+import asyncio
+import json
+
+import pytest
+from pydantic import BaseModel
+
+from k_llms_tpu import AsyncKLLMs, KLLMs
+
+
+def make_client(contents):
+    return KLLMs(backend="fake", responses=[contents])
+
+
+def test_create_n3_contract():
+    client = make_client(["yes", "yes", "no"])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3
+    )
+    # contract: choices[0]=consensus, 1..n originals, likelihoods present
+    assert len(resp.choices) == 3 + 1
+    assert resp.choices[0].index == 0
+    assert [c.index for c in resp.choices[1:]] == [1, 2, 3]
+    assert resp.choices[0].message.content == "yes"
+    assert resp.likelihoods == {"text": round(2 / 3, 5)}
+    assert [c.message.content for c in resp.choices[1:]] == ["yes", "yes", "no"]
+
+
+def test_create_single_choice_passthrough():
+    client = make_client(["hello"])
+    resp = client.chat.completions.create(messages=[{"role": "user", "content": "q"}], model="m")
+    assert len(resp.choices) == 1
+    assert resp.likelihoods is None
+
+
+def test_create_json_contents():
+    payload = {"city": "Paris", "country": "France"}
+    client = make_client([json.dumps(payload)] * 2 + [json.dumps({"city": "Paris", "country": "FR"})])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3
+    )
+    consensus = json.loads(resp.choices[0].message.content)
+    assert consensus["city"] == "Paris"
+    assert consensus["country"] == "France"
+    assert resp.likelihoods["city"] == 1.0
+    assert resp.likelihoods["country"] == round(2 / 3, 5)
+
+
+def test_parse_revalidates_into_model():
+    class UserInfo(BaseModel):
+        name: str
+        age: int
+
+    client = make_client(
+        [json.dumps({"name": "Bob", "age": 44})] * 3 + [json.dumps({"name": "Rob", "age": 44})]
+    )
+    resp = client.chat.completions.parse(
+        messages=[{"role": "user", "content": "q"}],
+        model="m",
+        n=4,
+        response_format=UserInfo,
+    )
+    parsed = resp.choices[0].message.parsed
+    assert isinstance(parsed, UserInfo)
+    assert parsed.name == "Bob"
+    assert parsed.age == 44
+    assert resp.likelihoods["name"] == 0.75
+
+
+def test_parse_failure_gives_none_parsed():
+    class Strict(BaseModel):
+        count: int
+
+    # close ints cluster together -> fractional cluster mean -> validation fails silently
+    client = make_client(
+        [json.dumps({"count": 100}), json.dumps({"count": 102}), json.dumps({"count": 103})]
+    )
+    resp = client.chat.completions.parse(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3, response_format=Strict
+    )
+    assert resp.choices[0].message.parsed is None
+    assert json.loads(resp.choices[0].message.content)["count"] == pytest.approx(305 / 3)
+
+
+def test_nested_list_consolidation():
+    docs = [
+        {"invoice": {"items": [{"sku": "widget large", "qty": 2}, {"sku": "gadget small", "qty": 1}]}},
+        {"invoice": {"items": [{"sku": "gadget small", "qty": 1}, {"sku": "widget large", "qty": 2}]}},
+        {"invoice": {"items": [{"sku": "widget large", "qty": 2}]}},
+    ]
+    client = make_client([json.dumps(d) for d in docs])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3
+    )
+    consensus = json.loads(resp.choices[0].message.content)
+    items = consensus["invoice"]["items"]
+    skus = [i["sku"] for i in items]
+    assert "widget large" in skus
+    assert resp.likelihoods["invoice"]["items"][0]["sku"] >= 0.5
+
+
+def test_async_client():
+    async def main():
+        client = AsyncKLLMs(backend="fake", responses=[["a", "a", "b"]])
+        return await client.chat.completions.create(
+            messages=[{"role": "user", "content": "q"}], model="m", n=3
+        )
+
+    resp = asyncio.run(main())
+    assert resp.choices[0].message.content == "a"
+    assert len(resp.choices) == 4
+
+
+def test_usage_preserved():
+    client = make_client(["x", "x"])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "hello world"}], model="m", n=2
+    )
+    assert resp.usage is not None
+    assert resp.usage.total_tokens == resp.usage.prompt_tokens + resp.usage.completion_tokens
+
+
+def test_get_embeddings_helper():
+    client = make_client(["x"])
+    embs = client.get_embeddings(["alpha", "beta"])
+    assert len(embs) == 2
+    assert len(embs[0]) == len(embs[1]) > 0
+    # deterministic
+    assert client.get_embeddings(["alpha"])[0] == embs[0]
