@@ -1,0 +1,136 @@
+"""Loader that imports the REFERENCE consensus engine as a test oracle.
+
+The reference at /root/reference imports packages absent from this environment
+(openai, retab, cachetools, unidecode). We register tiny in-memory stubs for
+those, then load `k_llms/utils/{majority_sorting,consensus_utils}.py` directly
+from the reference tree under a synthetic package name (bypassing the package
+__init__, which would drag in the full OpenAI client surface).
+
+This gives differential tests a ground-truth implementation to fuzz against.
+Nothing from the reference is copied into the repo; it is only executed at test
+time, and tests skip cleanly when /root/reference is absent.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import types
+import unicodedata
+
+REFERENCE_ROOT = "/root/reference"
+
+_cached = None
+
+
+def reference_available() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE_ROOT, "k_llms", "utils"))
+
+
+def _install_stub_modules() -> None:
+    # --- cachetools: only TTLCache is used ---
+    if "cachetools" not in sys.modules:
+        cachetools = types.ModuleType("cachetools")
+
+        class TTLCache(dict):
+            def __init__(self, maxsize=1024, ttl=300):
+                super().__init__()
+                self.maxsize = maxsize
+                self.ttl = ttl
+
+            def __setitem__(self, key, value):
+                if len(self) >= self.maxsize:
+                    self.clear()
+                super().__setitem__(key, value)
+
+        cachetools.TTLCache = TTLCache
+        sys.modules["cachetools"] = cachetools
+
+    # --- unidecode: mirror our ascii_fold so both engines sanitize identically ---
+    if "unidecode" not in sys.modules:
+        unidecode_mod = types.ModuleType("unidecode")
+
+        from k_llms_tpu.consensus.text import ascii_fold
+
+        unidecode_mod.unidecode = ascii_fold
+        sys.modules["unidecode"] = unidecode_mod
+
+    # --- openai: classes + completion_usage types ---
+    if "openai" not in sys.modules:
+        from k_llms_tpu.types import wire
+
+        openai_mod = types.ModuleType("openai")
+
+        class OpenAI:  # pragma: no cover - never actually called by the oracle
+            def __init__(self, *a, **kw):
+                raise RuntimeError("oracle must not construct an OpenAI client")
+
+        class AsyncOpenAI:
+            def __init__(self, *a, **kw):
+                raise RuntimeError("oracle must not construct an OpenAI client")
+
+        openai_mod.OpenAI = OpenAI
+        openai_mod.AsyncOpenAI = AsyncOpenAI
+
+        openai_types = types.ModuleType("openai.types")
+        completion_usage = types.ModuleType("openai.types.completion_usage")
+        completion_usage.CompletionUsage = wire.CompletionUsage
+        completion_usage.CompletionTokensDetails = wire.CompletionTokensDetails
+        completion_usage.PromptTokensDetails = wire.PromptTokensDetails
+
+        openai_mod.types = openai_types
+        openai_types.completion_usage = completion_usage
+        sys.modules["openai"] = openai_mod
+        sys.modules["openai.types"] = openai_types
+        sys.modules["openai.types.completion_usage"] = completion_usage
+
+    # --- retab: one type import, never instantiated in the paths we exercise ---
+    if "retab" not in sys.modules:
+        retab = types.ModuleType("retab")
+        retab_types = types.ModuleType("retab.types")
+        retab_docs = types.ModuleType("retab.types.documents")
+        retab_extract = types.ModuleType("retab.types.documents.extract")
+
+        class RetabParsedChatCompletion:  # minimal placeholder
+            pass
+
+        retab_extract.RetabParsedChatCompletion = RetabParsedChatCompletion
+        sys.modules["retab"] = retab
+        sys.modules["retab.types"] = retab_types
+        sys.modules["retab.types.documents"] = retab_docs
+        sys.modules["retab.types.documents.extract"] = retab_extract
+
+
+def load_reference_engine():
+    """Returns the reference consensus_utils module (cached)."""
+    global _cached
+    if _cached is not None:
+        return _cached
+    if not reference_available():
+        raise RuntimeError("reference tree not available")
+
+    _install_stub_modules()
+
+    utils_dir = os.path.join(REFERENCE_ROOT, "k_llms", "utils")
+    pkg_name = "_reference_oracle_utils"
+    if pkg_name not in sys.modules:
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [utils_dir]
+        sys.modules[pkg_name] = pkg
+
+    def _load(mod_name: str):
+        full = f"{pkg_name}.{mod_name}"
+        if full in sys.modules:
+            return sys.modules[full]
+        spec = importlib.util.spec_from_file_location(
+            full, os.path.join(utils_dir, f"{mod_name}.py")
+        )
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[full] = module
+        spec.loader.exec_module(module)
+        return module
+
+    _load("majority_sorting")
+    _cached = _load("consensus_utils")
+    return _cached

@@ -1,0 +1,212 @@
+"""Differential tests: our consensus engine vs the reference engine (oracle).
+
+Fuzzes randomized nested JSON-like sample sets through BOTH implementations of
+recursive alignment + consensus and asserts identical consensus values and
+likelihood structures. This is the bit-compatibility check SURVEY.md §7 stage 2
+demands for the "full of tie-breaks and magic constants" numerics.
+"""
+
+import math
+import random
+
+import pytest
+
+from reference_oracle import load_reference_engine, reference_available
+from k_llms_tpu.backends.fake import deterministic_embedding
+from k_llms_tpu.consensus.recursion import consensus_values, recursive_list_alignments
+from k_llms_tpu.consensus.settings import ConsensusSettings
+from k_llms_tpu.consensus.similarity import SimilarityScorer
+
+pytestmark = pytest.mark.skipif(
+    not reference_available(), reason="reference tree not mounted"
+)
+
+NAMES = ["Alice Smith", "Bob Jones", "Charlie Brown", "Dana White", "Eve Adams"]
+CITIES = ["Paris", "London", "New York City", "San Francisco", "Berlin"]
+SENTENCES = [
+    "The quick brown fox jumps over the lazy dog near the river bank",
+    "Machine learning models often disagree about ambiguous inputs entirely",
+    "Invoices must be paid within thirty days of the delivery date",
+    "The annual report shows strong growth in the European market segment",
+    "Customer satisfaction remains the primary goal of the support team",
+]
+ENUMS = ["yes", "no", "maybe", "active", "inactive", "pending"]
+
+
+def _perturb_string(rng, s, p=0.3):
+    if rng.random() > p:
+        return s
+    chars = list(s)
+    op = rng.choice(["swap", "drop", "dup", "case"])
+    if not chars:
+        return s
+    i = rng.randrange(len(chars))
+    if op == "swap" and len(chars) > 1:
+        j = min(i + 1, len(chars) - 1)
+        chars[i], chars[j] = chars[j], chars[i]
+    elif op == "drop":
+        chars.pop(i)
+    elif op == "dup":
+        chars.insert(i, chars[i])
+    else:
+        chars[i] = chars[i].upper()
+    return "".join(chars)
+
+
+def _perturb_number(rng, x, p=0.4):
+    if rng.random() > p:
+        return x
+    kind = rng.choice(["jitter", "big", "sign", "pow10"])
+    if kind == "jitter":
+        return round(x * (1 + rng.uniform(-0.02, 0.02)), 4)
+    if kind == "big":
+        return round(x * rng.uniform(1.5, 3.0), 4)
+    if kind == "sign":
+        return -x
+    return x * (10 ** rng.choice([-1, 1]))
+
+
+def make_record(rng, depth=0):
+    rec = {}
+    rec["name"] = rng.choice(NAMES)
+    rec["status"] = rng.choice(ENUMS)
+    rec["amount"] = round(rng.uniform(1, 5000), 2)
+    rec["active"] = rng.random() < 0.5
+    rec["note"] = rng.choice(SENTENCES)
+    if depth < 1 and rng.random() < 0.6:
+        rec["items"] = [
+            {"sku": rng.choice(CITIES) + " widget", "qty": rng.randint(1, 20)}
+            for _ in range(rng.randint(0, 3))
+        ]
+    if rng.random() < 0.3:
+        rec["reasoning___why"] = rng.choice(SENTENCES)
+    return rec
+
+
+def perturb_record(rng, rec, depth=0):
+    out = {}
+    for k, v in rec.items():
+        if rng.random() < 0.1:
+            continue  # drop field
+        if isinstance(v, str):
+            if k == "status":
+                out[k] = rng.choice(ENUMS) if rng.random() < 0.25 else v
+            else:
+                out[k] = _perturb_string(rng, v)
+        elif isinstance(v, bool):
+            out[k] = (not v) if rng.random() < 0.2 else v
+        elif isinstance(v, (int, float)):
+            out[k] = _perturb_number(rng, v)
+        elif isinstance(v, list):
+            lst = [perturb_record(rng, item, depth + 1) for item in v]
+            if rng.random() < 0.3 and lst:
+                lst.pop(rng.randrange(len(lst)))
+            if rng.random() < 0.3:
+                lst.append({"sku": rng.choice(CITIES) + " gadget", "qty": rng.randint(1, 9)})
+            rng.shuffle(lst)
+            out[k] = lst
+        elif isinstance(v, dict):
+            out[k] = perturb_record(rng, v, depth + 1)
+        else:
+            out[k] = v
+    if rng.random() < 0.1:
+        out["extra_field"] = rng.choice(ENUMS)
+    return out
+
+
+def make_samples(seed):
+    rng = random.Random(seed)
+    base = make_record(rng)
+    n = rng.randint(2, 6)
+    return [perturb_record(rng, base) for _ in range(n)]
+
+
+def _normalize(obj):
+    """Make floats comparable (both engines round to 5 where they round)."""
+    if isinstance(obj, float):
+        return round(obj, 9)
+    if isinstance(obj, dict):
+        return {k: _normalize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def run_reference(samples, method):
+    ref = load_reference_engine()
+    settings = ref.ConsensusSettings(string_similarity_method=method)
+
+    def embed(texts):
+        return [deterministic_embedding(t) for t in texts]
+
+    aligned, mappings = ref.recursive_list_alignments(
+        samples, method, embed, None, settings.min_support_ratio
+    )
+    aligned = [(d if isinstance(d, dict) else {}) for d in aligned]
+    value, conf = ref.consensus_values(aligned, settings, embed, client=None)
+    return _normalize(aligned), _normalize(value), _normalize(conf), mappings
+
+
+def run_ours(samples, method):
+    settings = ConsensusSettings(string_similarity_method=method)
+    scorer = SimilarityScorer(
+        method=method, embed_fn=lambda ts: [deterministic_embedding(t) for t in ts]
+    )
+    aligned, mappings = recursive_list_alignments(samples, scorer, settings.min_support_ratio)
+    aligned = [(d if isinstance(d, dict) else {}) for d in aligned]
+    value, conf = consensus_values(aligned, settings, scorer)
+    return _normalize(aligned), _normalize(value), _normalize(conf), mappings
+
+
+@pytest.mark.parametrize("method", ["levenshtein", "embeddings", "jaccard", "hamming"])
+@pytest.mark.parametrize("seed", range(25))
+def test_parity_random_structures(seed, method):
+    if method != "levenshtein" and seed >= 10:
+        pytest.skip("reduced seed budget for non-default methods")
+    samples = make_samples(seed)
+    ref_aligned, ref_value, ref_conf, ref_map = run_reference(samples, method)
+    our_aligned, our_value, our_conf, our_map = run_ours(samples, method)
+    assert our_aligned == ref_aligned, f"alignment diverged (seed={seed})"
+    assert our_value == ref_value, f"consensus value diverged (seed={seed})"
+    assert our_conf == ref_conf, f"likelihoods diverged (seed={seed})"
+    assert our_map == ref_map, f"key mappings diverged (seed={seed})"
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_parity_primitive_numeric(seed):
+    ref = load_reference_engine()
+    rng = random.Random(1000 + seed)
+    base = rng.uniform(-100, 100)
+    values = [
+        _perturb_number(rng, base, p=0.8) if rng.random() > 0.2 else None
+        for _ in range(rng.randint(2, 8))
+    ]
+
+    def embed(texts):
+        return [deterministic_embedding(t) for t in texts]
+
+    ref_val, ref_conf = ref.consensus_as_primitive(
+        values, ref.ConsensusSettings(), embed, client=None
+    )
+    scorer = SimilarityScorer(method="embeddings", embed_fn=lambda ts: embed(ts))
+    our_val, our_conf = __import__(
+        "k_llms_tpu.consensus.primitive", fromlist=["consensus_as_primitive"]
+    ).consensus_as_primitive(values, ConsensusSettings(), scorer)
+    if ref_val is None:
+        assert our_val is None
+    else:
+        assert our_val == pytest.approx(ref_val, abs=1e-12)
+    assert our_conf == pytest.approx(ref_conf, abs=1e-12)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_parity_voting(seed):
+    ref = load_reference_engine()
+    rng = random.Random(2000 + seed)
+    pool = ENUMS + [None, "São Paulo", "sao paulo"]
+    values = [rng.choice(pool) for _ in range(rng.randint(2, 9))]
+    ref_out = ref.voting_consensus(values, ref.ConsensusSettings())
+    from k_llms_tpu.consensus.voting import voting_consensus
+
+    our_out = voting_consensus(values, ConsensusSettings())
+    assert our_out == ref_out
