@@ -1,0 +1,169 @@
+"""TPU backend: KLLMs(backend="tpu") — the local JAX/XLA model engine.
+
+Replaces the reference's HTTP boundary (SURVEY.md §1 "model layer"): the n-way
+sample fan-out (`/root/reference/k_llms/resources/completions/completions.py:70-73`)
+becomes one batched decode on the device mesh; the embeddings side-channel
+(`client.py:75-122`) becomes mean-pooled hidden states from the same model; the
+llm-consensus string mode (`consensus_utils.py:1026-1048`, hardcoded gpt-5-mini)
+routes to the local model. Zero OpenAI calls (BASELINE.md target).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..consensus.prompts import SYSTEM_PROMPT_STRING_CONSENSUS_LLM
+from ..engine.engine import LocalEngine
+from ..engine.tokenizer import get_tokenizer
+from ..models.config import get_config
+from ..types import ChatCompletion
+from .base import Backend, ChatRequest
+
+# Embedding inputs crop at the same token cap as the reference (`client.py:12`).
+MAX_EMBEDDING_TOKENS = 8191
+
+
+class TpuBackend(Backend):
+    def __init__(
+        self,
+        model: str = "tiny",
+        checkpoint_path: Optional[str] = None,
+        tokenizer_path: Optional[str] = None,
+        mesh=None,
+        model_parallel: Optional[int] = None,
+        max_new_tokens: int = 256,
+        param_seed: int = 0,
+        engine: Optional[LocalEngine] = None,
+        **_: Any,
+    ):
+        self.model_name = model
+        config = get_config(model)
+        self.tokenizer = get_tokenizer(tokenizer_path)
+        params = None
+        if checkpoint_path:
+            from ..models.loader import load_checkpoint
+
+            params = load_checkpoint(checkpoint_path, config)
+        self.engine = engine or LocalEngine(
+            config,
+            params=params,
+            mesh=mesh,
+            model_parallel=model_parallel,
+            param_seed=param_seed,
+        )
+        self.default_max_new_tokens = max_new_tokens
+
+    # -- chat -------------------------------------------------------------
+    def chat_completion(self, request: ChatRequest) -> ChatCompletion:
+        tok = self.tokenizer
+        prompt_ids = tok.apply_chat_template(request.messages, add_generation_prompt=True)
+        n = max(1, request.n)
+
+        temperature = 1.0 if request.temperature is None else float(request.temperature)
+        max_new = request.max_tokens or self.default_max_new_tokens
+        result = self.engine.generate(
+            prompt_ids,
+            n=n,
+            max_new_tokens=max_new,
+            temperature=temperature,
+            top_p=request.top_p,
+            seed=request.seed,
+            eos_ids=tok.stop_ids,
+        )
+
+        stop_strings: List[str] = []
+        if isinstance(request.stop, str):
+            stop_strings = [request.stop]
+        elif isinstance(request.stop, list):
+            stop_strings = [s for s in request.stop if s]
+
+        choices: List[Dict[str, Any]] = []
+        completion_tokens = 0
+        for i in range(n):
+            length = int(result.lengths[i])
+            ids = [int(t) for t in result.tokens[i][:length]]
+            completion_tokens += length
+            text = tok.decode(ids)
+            finish = result.finish_reasons[i]
+            for s in stop_strings:
+                pos = text.find(s)
+                if pos != -1:
+                    text = text[:pos]
+                    finish = "stop"
+                    break
+            logprobs_payload = None
+            if request.logprobs:
+                logprobs_payload = {
+                    "content": [
+                        {
+                            "token": tok.decode([t]),
+                            "logprob": float(lp),
+                            "bytes": [b for b in tok.decode([t]).encode("utf-8")],
+                            "top_logprobs": [],
+                        }
+                        for t, lp in zip(ids, result.logprobs[i][:length].tolist())
+                    ]
+                }
+            choices.append(
+                {
+                    "finish_reason": finish,
+                    "index": i,
+                    "message": {"role": "assistant", "content": text},
+                    "logprobs": logprobs_payload,
+                    # Sequence-level sample log-likelihood (extension field; the
+                    # vendored types tolerate extras). Feeds likelihood-weighted
+                    # consensus (BASELINE.json config 3).
+                    "sample_logprob": float(np.sum(result.logprobs[i][:length])),
+                }
+            )
+
+        digest = hashlib.md5(repr((request.messages, request.seed)).encode()).hexdigest()[:12]
+        return ChatCompletion.model_validate(
+            {
+                "id": f"chatcmpl-tpu-{digest}",
+                "choices": choices,
+                "created": int(time.time()),
+                "model": request.model or self.model_name,
+                "object": "chat.completion",
+                "system_fingerprint": f"k-llms-tpu/{self.model_name}",
+                "usage": {
+                    "prompt_tokens": result.prompt_len,
+                    "completion_tokens": completion_tokens,
+                    "total_tokens": result.prompt_len + completion_tokens,
+                },
+            }
+        )
+
+    # -- embeddings -------------------------------------------------------
+    def embeddings(self, texts: List[str]) -> List[List[float]]:
+        token_lists = [
+            self.tokenizer.encode(t)[:MAX_EMBEDDING_TOKENS] for t in texts
+        ]
+        pooled = self.engine.embed_tokens(token_lists)
+        return [[float(x) for x in row] for row in pooled]
+
+    # -- llm-consensus ----------------------------------------------------
+    def llm_consensus(self, values: List[str]) -> str:
+        assert len(values) > 0, "Cannot build consensus string from empty list"
+        import json
+
+        messages = [
+            {"role": "system", "content": SYSTEM_PROMPT_STRING_CONSENSUS_LLM},
+            {"role": "user", "content": f"Input: {[json.dumps(v) for v in values]}\nOutput:"},
+        ]
+        ids = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
+        result = self.engine.generate(
+            ids,
+            n=1,
+            max_new_tokens=128,
+            temperature=0.0,
+            eos_ids=self.tokenizer.stop_ids,
+        )
+        text = self.tokenizer.decode(
+            [int(t) for t in result.tokens[0][: int(result.lengths[0])]]
+        ).strip()
+        return text if text else values[0]

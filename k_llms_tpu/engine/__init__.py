@@ -1,0 +1,12 @@
+"""Local inference engine: tokenizer, KV-cached batched decode, generation."""
+
+from .tokenizer import ByteTokenizer, HFTokenizer, get_tokenizer
+from .engine import GenerationResult, LocalEngine
+
+__all__ = [
+    "ByteTokenizer",
+    "HFTokenizer",
+    "get_tokenizer",
+    "GenerationResult",
+    "LocalEngine",
+]

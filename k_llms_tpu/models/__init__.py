@@ -1,0 +1,21 @@
+"""Local model zoo (pure-JAX, TPU-first).
+
+The reference has no model layer — its "hardware" is the OpenAI HTTP API
+(SURVEY.md §1). This package supplies the local replacement: functional
+Llama-family transformers (GQA + RoPE + RMSNorm + SwiGLU) as parameter pytrees
+plus jit-compiled apply functions, designed for pjit/GSPMD sharding over a
+(data, model) mesh.
+"""
+
+from .config import ModelConfig, get_config, register_config
+from .llama import init_params, forward, decode_step, prefill
+
+__all__ = [
+    "ModelConfig",
+    "get_config",
+    "register_config",
+    "init_params",
+    "forward",
+    "prefill",
+    "decode_step",
+]

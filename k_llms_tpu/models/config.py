@@ -1,0 +1,125 @@
+"""Model architecture configs.
+
+Flagship target is Llama-3-8B (BASELINE.md north star); the 1B config is the
+single-v5e-chip bench model (8B bf16 weights alone exceed one chip's 16 GB HBM —
+8B runs tensor-parallel over the mesh), and ``tiny`` keeps CI compiles fast.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: str = "bfloat16"
+    # byte tokenizer vocab fits any vocab_size >= 260; HF tokenizers use the full space
+    bos_token_id: int = 256
+    eos_token_id: int = 257
+    pad_token_id: int = 258
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+
+_REGISTRY: Dict[str, ModelConfig] = {}
+
+
+def register_config(config: ModelConfig) -> ModelConfig:
+    _REGISTRY[config.name] = config
+    return config
+
+
+def get_config(name: str) -> ModelConfig:
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(f"Unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+register_config(
+    ModelConfig(
+        name="llama-3-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_seq_len=8192,
+    )
+)
+
+register_config(
+    ModelConfig(
+        name="llama-3.2-1b",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_theta=500000.0,
+        max_seq_len=8192,
+    )
+)
+
+# Bench-scale model with a byte-level vocab: all FLOPs in the transformer stack,
+# negligible embedding table, fits one v5e chip with room for n=32 KV caches.
+register_config(
+    ModelConfig(
+        name="llama-1b-byte",
+        vocab_size=512,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=4096,
+    )
+)
+
+register_config(
+    ModelConfig(
+        name="tiny",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=160,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=4096,
+        dtype="float32",
+    )
+)

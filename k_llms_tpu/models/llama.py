@@ -1,0 +1,366 @@
+"""Pure-functional Llama-family transformer (GQA + RoPE + RMSNorm + SwiGLU).
+
+TPU-first design notes:
+- Parameters are a pytree with all layers STACKED on a leading axis and the
+  layer stack applied with ``lax.scan`` — one traced block regardless of depth,
+  so XLA compiles fast and fuses identically for 2 or 32 layers.
+- All matmuls are laid out (tokens, features) x (features, features') so they
+  tile straight onto the MXU; bf16 weights/activations, f32 norm/softmax
+  accumulation.
+- KV caches are preallocated [L, B, S, KVH, D] and updated with
+  ``lax.dynamic_update_slice_in_dim`` — static shapes, no data-dependent
+  control flow, jit-stable across decode steps.
+- The decode path supports a SHARED-PREFIX cache: the prompt (identical across
+  the n consensus samples) is prefilled once at batch=1 and every sample
+  attends to it broadcast, so prompt KV is stored once instead of n times —
+  the HBM win that lets n=32 consensus fit on one chip.
+
+This file replaces the reference's model layer, which is the remote OpenAI API
+(`/root/reference/k_llms/resources/completions/completions.py:73`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Stacked per-layer cache: k/v are [num_layers, batch, max_len, kv_heads, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+    dtype = dtype or config.jax_dtype
+    shape = (config.num_layers, batch, max_len, config.num_kv_heads, config.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# Parameter init
+# ---------------------------------------------------------------------------
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
+    """Random (scaled-normal) initialization; real checkpoints come from
+    k_llms_tpu.models.loader."""
+    dtype = dtype or config.jax_dtype
+    H, I, V = config.hidden_size, config.intermediate_size, config.vocab_size
+    L, Q, KV = config.num_layers, config.q_dim, config.kv_dim
+
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": normal(k_embed, (V, H), 1.0 / math.sqrt(H)),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype),
+            "wq": normal(ks[0], (L, H, Q), 1.0 / math.sqrt(H)),
+            "wk": normal(ks[1], (L, H, KV), 1.0 / math.sqrt(H)),
+            "wv": normal(ks[2], (L, H, KV), 1.0 / math.sqrt(H)),
+            "wo": normal(ks[3], (L, Q, H), 1.0 / math.sqrt(Q)),
+            "mlp_norm": jnp.ones((L, H), dtype),
+            "w_gate": normal(ks[4], (L, H, I), 1.0 / math.sqrt(H)),
+            "w_up": normal(ks[5], (L, H, I), 1.0 / math.sqrt(H)),
+            "w_down": normal(ks[6], (L, I, H), 1.0 / math.sqrt(I)),
+        },
+        "final_norm": jnp.ones((H,), dtype),
+        "lm_head": normal(k_head, (H, V), 1.0 / math.sqrt(H)),
+    }
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * weight
+
+
+def rope_embed(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, S, heads, D], positions: [B, S]."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """q: [B, Sq, QH, D], k: [B, Sk, KVH, D] -> scores [B, QH, Sq, Sk]."""
+    B, Sq, QH, D = q.shape
+    KVH = k.shape[2]
+    G = QH // KVH
+    qg = q.reshape(B, Sq, KVH, G, D)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    return scores.reshape(B, QH, Sq, k.shape[1])
+
+
+def _gqa_scores_shared(q: jax.Array, k: jax.Array) -> jax.Array:
+    """Shared-prefix scores: q [B, Sq, QH, D] vs ONE key set k [1, Sk, KVH, D].
+    The prefix KV is stored once and broadcast across the n samples — no
+    materialized per-sample copies (the HBM saving behind n=32 on one chip)."""
+    B, Sq, QH, D = q.shape
+    KVH = k.shape[2]
+    G = QH // KVH
+    qg = q.reshape(B, Sq, KVH, G, D)
+    scores = jnp.einsum("bqhgd,khd->bhgqk", qg, k[0], preferred_element_type=jnp.float32)
+    return scores.reshape(B, QH, Sq, k.shape[1])
+
+
+def _gqa_values(weights: jax.Array, v: jax.Array) -> jax.Array:
+    """weights: [B, QH, Sq, Sk], v: [B, Sk, KVH, D] -> [B, Sq, QH, D]."""
+    B, QH, Sq, Sk = weights.shape
+    KVH = v.shape[2]
+    G = QH // KVH
+    wg = weights.reshape(B, KVH, G, Sq, Sk)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v.astype(jnp.float32))
+    return out.reshape(B, Sq, QH, v.shape[3])
+
+
+def _gqa_values_shared(weights: jax.Array, v: jax.Array) -> jax.Array:
+    """weights: [B, QH, Sq, Sk], shared v: [1, Sk, KVH, D] -> [B, Sq, QH, D]."""
+    B, QH, Sq, Sk = weights.shape
+    KVH = v.shape[2]
+    G = QH // KVH
+    wg = weights.reshape(B, KVH, G, Sq, Sk)
+    out = jnp.einsum("bhgqk,khd->bqhgd", wg, v[0].astype(jnp.float32))
+    return out.reshape(B, Sq, QH, v.shape[3])
+
+
+def _block(
+    config: ModelConfig,
+    layer: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    kv: Tuple[jax.Array, jax.Array],
+    write_index: Optional[jax.Array],
+    key_mask: jax.Array,
+    prefix_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
+    prefix_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """One transformer block over (possibly cached) keys.
+
+    x: [B, Sq, H]; kv: layer cache (k, v) each [B, Smax, KVH, D];
+    write_index: scalar slot where this call's k/v are written (None = positions
+    0..Sq, i.e. prefill); key_mask: [B|1, Sq, Smax] additive-mask booleans for the
+    self cache; prefix_kv/prefix_mask: optional shared-prompt cache [1, P, KVH, D]
+    and [1|B, Sq, P].
+    """
+    B, Sq, H = x.shape
+    scale = 1.0 / math.sqrt(config.head_dim)
+
+    h = rms_norm(x, layer["attn_norm"], config.rms_eps)
+    q = (h @ layer["wq"]).reshape(B, Sq, config.num_heads, config.head_dim)
+    k = (h @ layer["wk"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
+    v = (h @ layer["wv"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
+
+    q = rope_embed(q, positions, config.rope_theta)
+    k = rope_embed(k, positions, config.rope_theta)
+
+    cache_k, cache_v = kv
+    if write_index is None:
+        cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(cache_k.dtype), 0, axis=1)
+        cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(cache_v.dtype), 0, axis=1)
+    else:
+        cache_k = lax.dynamic_update_slice_in_dim(
+            cache_k, k.astype(cache_k.dtype), write_index, axis=1
+        )
+        cache_v = lax.dynamic_update_slice_in_dim(
+            cache_v, v.astype(cache_v.dtype), write_index, axis=1
+        )
+
+    scores = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, Smax] f32
+    neg = jnp.finfo(jnp.float32).min
+    scores = jnp.where(key_mask[:, None, :, :], scores, neg)
+
+    if prefix_kv is not None:
+        pk, pv = prefix_kv
+        p_scores = _gqa_scores_shared(q, pk) * scale  # [B, QH, Sq, P]
+        p_scores = jnp.where(prefix_mask[:, None, :, :], p_scores, neg)
+        all_scores = jnp.concatenate([p_scores, scores], axis=-1)
+        weights = jax.nn.softmax(all_scores, axis=-1)
+        P = pk.shape[1]
+        attn = _gqa_values_shared(weights[..., :P], pv) + _gqa_values(weights[..., P:], cache_v)
+    else:
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = _gqa_values(weights, cache_v)
+
+    attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
+    x = x + attn @ layer["wo"]
+
+    h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"])
+    up = h @ layer["w_up"]
+    x = x + (gate * up) @ layer["w_down"]
+    return x, (cache_k, cache_v)
+
+
+def _apply_stack(
+    config: ModelConfig,
+    params: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    cache: KVCache,
+    write_index: Optional[jax.Array],
+    key_mask: jax.Array,
+    prefix: Optional[KVCache] = None,
+    prefix_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, KVCache]:
+    """Scan the layer stack. cache k/v: [L, B, Smax, KVH, D]."""
+
+    def body(carry, scanned):
+        x = carry
+        layer_params, layer_kv, layer_prefix = scanned
+        prefix_kv = None
+        if layer_prefix is not None:
+            prefix_kv = (layer_prefix[0], layer_prefix[1])
+        x, new_kv = _block(
+            config,
+            layer_params,
+            x,
+            positions,
+            (layer_kv[0], layer_kv[1]),
+            write_index,
+            key_mask,
+            prefix_kv=prefix_kv,
+            prefix_mask=prefix_mask,
+        )
+        return x, new_kv
+
+    layers = params["layers"]
+    kv_stacked = (cache.k, cache.v)
+    prefix_stacked = (prefix.k, prefix.v) if prefix is not None else None
+
+    if prefix_stacked is None:
+        x, new_kv = lax.scan(
+            lambda c, s: body(c, (s[0], s[1], None)),
+            x,
+            (layers, kv_stacked),
+        )
+    else:
+        x, new_kv = lax.scan(
+            lambda c, s: body(c, (s[0], s[1], s[2])),
+            x,
+            (layers, kv_stacked, prefix_stacked),
+        )
+
+    return x, KVCache(k=new_kv[0], v=new_kv[1])
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,
+    pad_mask: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Full-sequence causal forward (no cache). Returns (logits f32 [B,S,V],
+    final hidden states [B,S,H]) — hidden states feed the on-device embedding
+    provider (mean-pooled) used by the consensus similarity scorer."""
+    B, S = tokens.shape
+    positions = jnp.cumsum(pad_mask.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    key_mask = causal[None, :, :] & pad_mask[:, None, :].astype(bool)
+
+    cache = init_cache(config, B, S)
+    x, _ = _apply_stack(config, params, x, positions, cache, None, key_mask)
+    h = rms_norm(x, params["final_norm"], config.rms_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    return logits, h
+
+
+def prefill(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,
+    prompt_len: jax.Array,
+) -> Tuple[jax.Array, KVCache]:
+    """Prefill the shared prompt at batch=1. tokens: [1, S] (bucket-padded on the
+    right), prompt_len: scalar valid length. Returns (last-token logits [1, V],
+    prefix KVCache [L, 1, S, KVH, D])."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    valid = jnp.arange(S)[None, :] < prompt_len  # [1, S]
+    key_mask = causal[None, :, :] & valid[:, None, :]
+
+    cache = init_cache(config, B, S)
+    x, cache = _apply_stack(config, params, x, positions, cache, None, key_mask)
+    h = rms_norm(x, params["final_norm"], config.rms_eps)
+    last = jnp.take_along_axis(h, (prompt_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1)
+    logits = (last[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def decode_step(
+    config: ModelConfig,
+    params: Params,
+    token: jax.Array,
+    step: jax.Array,
+    prompt_len: jax.Array,
+    gen_cache: KVCache,
+    prefix: KVCache,
+) -> Tuple[jax.Array, KVCache]:
+    """One decode step for all n samples against the shared prefix.
+
+    token: [B] current tokens; step: scalar decode index (0-based); prompt_len:
+    scalar; gen_cache: [L, B, G, KVH, D]; prefix: [L, 1, P, KVH, D].
+    Returns (logits f32 [B, V], updated gen_cache).
+    """
+    B = token.shape[0]
+    G = gen_cache.max_len
+    P = prefix.max_len
+
+    positions = (prompt_len + step) * jnp.ones((B, 1), jnp.int32)
+    x = jnp.take(params["embed"], token[:, None], axis=0)
+
+    # Self (generated) keys: slots 0..step inclusive are valid after the write.
+    self_mask = (jnp.arange(G)[None, None, :] <= step) & jnp.ones((B, 1, 1), bool)
+    # Prefix keys: positions < prompt_len are valid.
+    prefix_mask = (jnp.arange(P)[None, None, :] < prompt_len) & jnp.ones((1, 1, 1), bool)
+
+    x, gen_cache = _apply_stack(
+        config,
+        params,
+        x,
+        positions,
+        gen_cache,
+        step,
+        self_mask,
+        prefix=prefix,
+        prefix_mask=prefix_mask,
+    )
+    h = rms_norm(x, params["final_norm"], config.rms_eps)
+    logits = (h[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, gen_cache

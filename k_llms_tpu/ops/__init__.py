@@ -1,0 +1,5 @@
+"""Device-side ops: sampling, attention kernels, ring attention."""
+
+from .sampling import sample_logits
+
+__all__ = ["sample_logits"]

@@ -1,0 +1,61 @@
+"""On-device token sampling with logprob capture.
+
+The n consensus samples are one batched categorical draw: per-sample RNG keys
+(folded from the request seed) make the samples diverse yet reproducible —
+covering the reference's `seed` pass-through
+(`/root/reference/k_llms/resources/completions/completions.py:57-58`) that the
+OpenAI backend only best-effort honors. The logprob of every emitted token is
+captured from the UNtempered distribution (that is what OpenAI's `logprobs`
+reports) and feeds the likelihood-weighted consensus mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_p: Optional[float] = None,
+    top_k: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sample next tokens. logits: [B, V] f32; key: one PRNG key, folded per row.
+
+    Returns (tokens [B] int32, logprobs [B] f32 — log p(token) under the
+    untempered model distribution).
+    """
+    B, V = logits.shape
+    model_logprobs = jax.nn.log_softmax(logits, axis=-1)
+
+    if temperature == 0.0:
+        tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        sampling_logits = logits / temperature
+
+        if top_k is not None and top_k < V:
+            kth = jnp.sort(sampling_logits, axis=-1)[:, V - top_k][:, None]
+            sampling_logits = jnp.where(sampling_logits < kth, -jnp.inf, sampling_logits)
+
+        if top_p is not None and top_p < 1.0:
+            sorted_logits = jnp.sort(sampling_logits, axis=-1)[:, ::-1]
+            sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cumulative = jnp.cumsum(sorted_probs, axis=-1)
+            # Keep the smallest prefix with cumulative mass >= top_p (the token
+            # that crosses the boundary stays in).
+            keep_sorted = (cumulative - sorted_probs) < top_p
+            threshold = jnp.min(
+                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+            )
+            sampling_logits = jnp.where(sampling_logits < threshold, -jnp.inf, sampling_logits)
+
+        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, jnp.arange(B))
+        tokens = jax.vmap(lambda k, l: jax.random.categorical(k, l))(keys, sampling_logits)
+        tokens = tokens.astype(jnp.int32)
+
+    logprobs = jnp.take_along_axis(model_logprobs, tokens[:, None], axis=-1)[:, 0]
+    return tokens, logprobs

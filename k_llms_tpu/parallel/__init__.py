@@ -1,0 +1,20 @@
+"""Device-mesh parallelism.
+
+The reference's only parallelism is provider-side sampling over one HTTP call
+(SURVEY.md §2.3). Here parallelism is first-class: a (data, model) mesh where
+the n consensus samples ride the data axis over ICI and the model weights are
+tensor-parallel over the model axis; multi-host DCN via jax.distributed.
+"""
+
+from .mesh import DATA_AXIS, MODEL_AXIS, auto_mesh, make_mesh
+from .sharding import batch_spec, cache_specs, param_specs
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "auto_mesh",
+    "make_mesh",
+    "param_specs",
+    "cache_specs",
+    "batch_spec",
+]

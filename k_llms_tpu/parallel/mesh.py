@@ -1,0 +1,52 @@
+"""Mesh construction.
+
+Axes:
+- ``data``: the n consensus samples (data parallel over ICI) — the TPU-native
+  replacement for the reference's provider-side n fan-out
+  (`/root/reference/k_llms/resources/completions/completions.py:70-73`).
+- ``model``: tensor parallelism for weights that exceed one chip's HBM
+  (Llama-3-8B bf16 = 16 GB = a whole v5e chip on its own).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data: int,
+    model: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if data * model > len(devices):
+        raise ValueError(
+            f"mesh {data}x{model} needs {data * model} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[: data * model]).reshape(data, model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def auto_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_parallel: Optional[int] = None,
+) -> Mesh:
+    """Factorize the device count into (data, model).
+
+    Default: all-model for big weights? No — consensus decoding is
+    throughput-bound on the n samples, so default is all-data with
+    ``model_parallel`` carved out only when requested (or set it to fit weights).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    mp = model_parallel or 1
+    if n % mp != 0:
+        raise ValueError(f"model_parallel={mp} does not divide device count {n}")
+    return make_mesh(n // mp, mp, devices)

@@ -44,7 +44,9 @@ def _build_request(
 ) -> ChatRequest:
     kwargs = dict(kwargs)
     kwargs.pop("stream", None)  # streaming unsupported, like the reference (:36)
+    logprobs = kwargs.pop("logprobs", None)
     return ChatRequest(
+        logprobs=logprobs,
         messages=messages,
         model=model,
         n=n or 1,

@@ -1,16 +1,25 @@
-"""Test configuration: force an 8-device virtual CPU platform before JAX initializes.
+"""Test configuration: force an 8-device virtual CPU platform before JAX
+backends initialize.
 
-The reference (k-LLMs) has no hermetic test story (SURVEY.md §4); ours runs the whole
-framework — including the "distributed" decode path — on a simulated 8-device CPU mesh
-so no TPU hardware is needed for CI.
+The reference (k-LLMs) has no hermetic test story (SURVEY.md §4); ours runs the
+whole framework — including the "distributed" decode path — on a simulated
+8-device CPU mesh so no TPU hardware is needed for CI.
+
+NB: this environment pre-sets JAX_PLATFORMS=axon via sitecustomize, so a plain
+env-var default is not enough — we must update jax.config before first device
+use.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

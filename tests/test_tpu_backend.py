@@ -1,0 +1,100 @@
+"""End-to-end KLLMs(backend="tpu") on the virtual CPU mesh: the BASELINE.md
+acceptance path — n-way consensus with zero OpenAI calls."""
+
+import numpy as np
+import pytest
+
+from k_llms_tpu import KLLMs
+from k_llms_tpu.backends.tpu import TpuBackend
+
+
+@pytest.fixture(scope="module")
+def client():
+    return KLLMs(backend="tpu", model="tiny", max_new_tokens=16)
+
+
+def test_create_consensus_contract(client):
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "Tell me something"}],
+        model="tiny",
+        n=4,
+        temperature=1.0,
+        seed=11,
+    )
+    assert len(resp.choices) == 5  # consensus + 4 samples
+    assert resp.choices[0].index == 0
+    assert resp.likelihoods is not None
+    assert resp.usage.prompt_tokens > 0
+    assert resp.usage.completion_tokens > 0
+    assert resp.system_fingerprint.startswith("k-llms-tpu/")
+
+
+def test_create_seed_reproducible(client):
+    kwargs = dict(
+        messages=[{"role": "user", "content": "again"}], model="tiny", n=3, seed=5
+    )
+    a = client.chat.completions.create(**kwargs)
+    b = client.chat.completions.create(**kwargs)
+    assert [c.message.content for c in a.choices] == [c.message.content for c in b.choices]
+
+
+def test_greedy_unanimous_consensus(client):
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "x"}], model="tiny", n=3, temperature=0.0, seed=1
+    )
+    originals = [c.message.content for c in resp.choices[1:]]
+    assert originals[0] == originals[1] == originals[2]
+    assert resp.choices[0].message.content == originals[0]
+    assert resp.likelihoods == {"text": 1.0}
+
+
+def test_logprobs_surface(client):
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "lp"}],
+        model="tiny",
+        n=2,
+        seed=2,
+        logprobs=True,
+    )
+    sample = resp.choices[1]
+    assert sample.logprobs is not None
+    assert len(sample.logprobs.content) > 0
+    assert sample.logprobs.content[0].logprob <= 0.0
+
+
+def test_backend_embeddings_and_llm_consensus():
+    backend = TpuBackend(model="tiny", max_new_tokens=8)
+    embs = backend.embeddings(["alpha beta", "alpha beta", "gamma"])
+    assert len(embs) == 3
+    np.testing.assert_allclose(embs[0], embs[1], rtol=1e-5)
+    out = backend.llm_consensus(["a", "b", "a"])
+    assert isinstance(out, str) and len(out) >= 0
+
+
+def test_stop_string_truncates():
+    backend = TpuBackend(model="tiny", max_new_tokens=12)
+    client = KLLMs(backend=backend)
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "y"}], model="tiny", n=1, seed=3
+    )
+    full = resp.choices[0].message.content
+    if len(full) > 1:
+        stop_char = full[1]
+        resp2 = client.chat.completions.create(
+            messages=[{"role": "user", "content": "y"}],
+            model="tiny",
+            n=1,
+            seed=3,
+            stop=stop_char,
+        )
+        # single-sample passthrough keeps the full text; multi-sample path truncates.
+        # Use n=2 to exercise the truncation path deterministically.
+        resp3 = client.chat.completions.create(
+            messages=[{"role": "user", "content": "y"}],
+            model="tiny",
+            n=2,
+            seed=3,
+            stop=stop_char,
+        )
+        for choice in resp3.choices[1:]:
+            assert stop_char not in (choice.message.content or "")
