@@ -1,0 +1,92 @@
+"""Headline benchmark (driver-run, real TPU).
+
+Measures the BASELINE.md target: n=32 consensus p50 latency vs single-sample
+p50 on a ~1B-param Llama-architecture model, end-to-end through the public
+``KLLMs(backend="tpu")`` client (batched decode + on-device embeddings +
+host-side consensus), plus decode tokens/sec/chip.
+
+Prints ONE JSON line:
+  metric = n32_consensus_p50_over_single_p50 (lower is better, target < 2.0)
+  vs_baseline = 2.0 / value  (>1.0 means the target is beaten)
+"""
+
+import json
+import statistics
+import time
+
+import jax
+
+RUNS = 5
+MAX_NEW = 64
+N_CONSENSUS = 32
+
+
+def main() -> None:
+    from k_llms_tpu import KLLMs
+    from k_llms_tpu.backends.tpu import TpuBackend
+
+    model = "llama-1b-byte"
+    backend = TpuBackend(model=model, max_new_tokens=MAX_NEW)
+    client = KLLMs(backend=backend, model=model)
+
+    messages = [
+        {
+            "role": "user",
+            "content": (
+                "Extract the invoice fields from this document: ACME Corp, "
+                "invoice number INV-2024-00417, issued March 3rd, total due "
+                "$4,310.55, payment terms net 30, contact billing@acme.example."
+            ),
+        }
+    ]
+
+    def run(n: int) -> float:
+        t0 = time.perf_counter()
+        client.chat.completions.create(
+            messages=messages, model=model, n=n, temperature=0.8, top_p=0.95, seed=1234
+        )
+        return time.perf_counter() - t0
+
+    # Warmup / compile both programs.
+    run(1)
+    run(N_CONSENSUS)
+
+    single = [run(1) for _ in range(RUNS)]
+    consensus = [run(N_CONSENSUS) for _ in range(RUNS)]
+    p50_single = statistics.median(single)
+    p50_consensus = statistics.median(consensus)
+    ratio = p50_consensus / p50_single
+
+    # Raw decode throughput (engine-level, excludes host consensus).
+    tok = backend.tokenizer
+    ids = tok.apply_chat_template(messages)
+    backend.engine.generate(ids, n=N_CONSENSUS, max_new_tokens=MAX_NEW, seed=0)
+    t0 = time.perf_counter()
+    result = backend.engine.generate(ids, n=N_CONSENSUS, max_new_tokens=MAX_NEW, seed=7)
+    decode_s = time.perf_counter() - t0
+    tokens_generated = int(result.lengths.sum())
+    tokens_per_sec_chip = tokens_generated / decode_s / max(1, len(jax.devices()))
+
+    print(
+        json.dumps(
+            {
+                "metric": "n32_consensus_p50_over_single_p50",
+                "value": round(ratio, 4),
+                "unit": "x",
+                "vs_baseline": round(2.0 / ratio, 4),
+                "detail": {
+                    "model": model,
+                    "device": str(jax.devices()[0]),
+                    "p50_single_s": round(p50_single, 4),
+                    "p50_n32_consensus_s": round(p50_consensus, 4),
+                    "decode_tokens_per_sec_chip": round(tokens_per_sec_chip, 1),
+                    "max_new_tokens": MAX_NEW,
+                    "runs": RUNS,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

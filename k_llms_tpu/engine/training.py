@@ -1,0 +1,74 @@
+"""Sharded training step (causal-LM fine-tuning).
+
+Beyond-reference capability: the reference is inference-only (SURVEY.md §5,
+"Checkpoint/resume: absent"), but a local-model framework should be able to
+adapt its model. One jit-compiled train step — loss, grads, optax update — with
+the same (data, model) mesh sharding as inference: batch over ``data``, weights
+tensor-parallel over ``model``; GSPMD inserts the gradient reduce-scatters over
+ICI. Also the program exercised by ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.llama import forward
+from ..parallel.mesh import DATA_AXIS
+from ..parallel.sharding import param_specs
+
+
+def causal_lm_loss(
+    config: ModelConfig, params: Dict[str, Any], tokens: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Next-token cross entropy over valid (non-pad) positions."""
+    logits, _ = forward(config, params, tokens, mask)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    valid = mask[:, 1:].astype(jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def make_train_step(
+    config: ModelConfig,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    mesh: Optional[Mesh] = None,
+):
+    """Returns (init_state, train_step). train_step is jitted with explicit
+    sharding when a mesh is given."""
+    optimizer = optimizer or optax.adamw(1e-4)
+
+    def init_state(params):
+        return optimizer.init(params)
+
+    def train_step(params, opt_state, tokens, mask):
+        loss, grads = jax.value_and_grad(partial(causal_lm_loss, config))(
+            params, tokens, mask
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is not None:
+        pspecs = param_specs(config)
+        param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+        batch_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        replicated = NamedSharding(mesh, P())
+        train_step = jax.jit(
+            train_step,
+            in_shardings=(param_sh, None, batch_sh, batch_sh),
+            out_shardings=(param_sh, None, replicated),
+        )
+    else:
+        train_step = jax.jit(train_step)
+
+    return init_state, train_step

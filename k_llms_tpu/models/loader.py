@@ -1,0 +1,132 @@
+"""Checkpoint I/O: orbax-native save/load + HF safetensors import.
+
+The reference is a stateless SDK with no checkpointing (SURVEY.md §5); the local
+backend needs weight loading only. Two formats:
+
+- **orbax**: our native format — the params pytree as-is, restorable directly
+  onto a sharded mesh.
+- **safetensors**: import path for Hugging Face Llama checkpoints
+  (model*.safetensors + config.json), remapped into our stacked-layer layout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+
+def save_checkpoint(path: str, params: Dict[str, Any]) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(path, params)
+    checkpointer.wait_until_finished()
+
+
+def load_orbax(path: str) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    checkpointer = ocp.StandardCheckpointer()
+    return checkpointer.restore(os.path.abspath(path))
+
+
+def _hf_key(layer: int, name: str) -> str:
+    return f"model.layers.{layer}.{name}.weight"
+
+
+def load_safetensors(path: str, config: ModelConfig, dtype=None) -> Dict[str, Any]:
+    """Import an HF Llama checkpoint directory into the stacked-params layout.
+
+    HF stores per-layer [out, in] matrices; our layout is [in, out] stacked on a
+    leading layer axis. HF's q/k weights are in interleaved-rotary order which
+    matches the half-split RoPE used here after the standard permutation.
+    """
+    from safetensors import safe_open
+
+    dtype = dtype or config.jax_dtype
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path!r}")
+
+    tensors: Dict[str, np.ndarray] = {}
+    for file in files:
+        with safe_open(file, framework="numpy") as f:
+            for key in f.keys():
+                tensors[key] = f.get_tensor(key)
+
+    def t(key: str) -> np.ndarray:  # HF [out, in] -> ours [in, out]
+        return np.asarray(tensors[key]).T
+
+    # NB on RoPE layout: HF Llama applies rotary with the same split-half
+    # (rotate_half) convention our rope_embed uses, so q/k weights import
+    # without re-permutation.
+    L = config.num_layers
+    layers = {
+        "attn_norm": np.stack([np.asarray(tensors[_hf_key(i, "input_layernorm")]) for i in range(L)]),
+        "wq": np.stack([t(_hf_key(i, "self_attn.q_proj")) for i in range(L)]),
+        "wk": np.stack([t(_hf_key(i, "self_attn.k_proj")) for i in range(L)]),
+        "wv": np.stack([t(_hf_key(i, "self_attn.v_proj")) for i in range(L)]),
+        "wo": np.stack([t(_hf_key(i, "self_attn.o_proj")) for i in range(L)]),
+        "mlp_norm": np.stack([np.asarray(tensors[_hf_key(i, "post_attention_layernorm")]) for i in range(L)]),
+        "w_gate": np.stack([t(_hf_key(i, "mlp.gate_proj")) for i in range(L)]),
+        "w_up": np.stack([t(_hf_key(i, "mlp.up_proj")) for i in range(L)]),
+        "w_down": np.stack([t(_hf_key(i, "mlp.down_proj")) for i in range(L)]),
+    }
+
+    embed = np.asarray(tensors["model.embed_tokens.weight"])
+    if "lm_head.weight" in tensors:
+        lm_head = np.asarray(tensors["lm_head.weight"]).T
+    else:  # tied embeddings (llama-3.2-1b)
+        lm_head = embed.T
+
+    params = {
+        "embed": jnp.asarray(embed, dtype),
+        "layers": {k: jnp.asarray(v, dtype) for k, v in layers.items()},
+        "final_norm": jnp.asarray(np.asarray(tensors["model.norm.weight"]), dtype),
+        "lm_head": jnp.asarray(lm_head, dtype),
+    }
+    return params
+
+
+def load_checkpoint(path: str, config: ModelConfig, dtype=None) -> Dict[str, Any]:
+    """Dispatch on content: safetensors dir vs orbax dir."""
+    if os.path.isdir(path) and any(f.endswith(".safetensors") for f in os.listdir(path)):
+        return load_safetensors(path, config, dtype)
+    return load_orbax(path)
+
+
+def config_from_hf(path: str) -> Optional[ModelConfig]:
+    """Build a ModelConfig from an HF config.json, if present."""
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.exists(cfg_path):
+        return None
+    with open(cfg_path) as f:
+        hf = json.load(f)
+    hidden = hf["hidden_size"]
+    heads = hf["num_attention_heads"]
+    return ModelConfig(
+        name=os.path.basename(os.path.normpath(path)),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hidden,
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=hf.get("num_key_value_heads", heads),
+        head_dim=hf.get("head_dim", hidden // heads),
+        rope_theta=hf.get("rope_theta", 500000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        max_seq_len=min(hf.get("max_position_embeddings", 8192), 8192),
+        bos_token_id=hf.get("bos_token_id", 128000),
+        eos_token_id=hf.get("eos_token_id", 128001),
+        pad_token_id=hf.get("pad_token_id") or hf.get("eos_token_id", 128001),
+    )
