@@ -67,11 +67,22 @@ def _consensus_over_contents(
 ):
     """Shared align-then-vote step over parsed choice contents."""
     if len(contents) >= 2:
-        aligned_seq, _ = recursive_list_alignments(
-            contents,
-            scorer,
-            consensus_settings.min_support_ratio,
-        )
+        if consensus_settings.aligner == "key":
+            # Swap point (reference `consolidation.py:22`): key-based aligner
+            # behind the same signature.
+            from ..keyalign import recursive_align
+
+            aligned_seq, _ = recursive_align(
+                contents,
+                consensus_settings.string_similarity_method,
+                consensus_settings.min_support_ratio,
+            )
+        else:
+            aligned_seq, _ = recursive_list_alignments(
+                contents,
+                scorer,
+                consensus_settings.min_support_ratio,
+            )
         contents = [(d if isinstance(d, dict) else {}) for d in aligned_seq]
     return consensus_values(
         contents,

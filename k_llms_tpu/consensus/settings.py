@@ -12,6 +12,7 @@ from pydantic import BaseModel
 
 StringSimilarityMethod = Literal["levenshtein", "jaccard", "hamming", "embeddings"]
 StringConsensusMethod = Literal["centroid", "llm-consensus"]
+AlignerMethod = Literal["similarity", "key"]
 
 # Floor used everywhere a similarity must stay strictly positive
 # (reference `consensus_utils.py:78`).
@@ -32,6 +33,9 @@ SPECIAL_FIELD_PREFIXES = ["reasoning___", "source___"]
 
 class ConsensusSettings(BaseModel):
     allow_none_as_candidate: bool = False
+    # Structural aligner: "similarity" (default pipeline) or "key" (the latent
+    # key-based aligner — the reference's swap point at `consolidation.py:22`).
+    aligner: AlignerMethod = "similarity"
     # String-specific settings
     string_similarity_method: StringSimilarityMethod = "embeddings"
     string_consensus_method: StringConsensusMethod = "centroid"

@@ -1,0 +1,370 @@
+"""Key-based recursive alignment engine.
+
+Parity target: `/root/reference/k_llms/utils/key_based_alignment.py` —
+``_get_key_tuple`` :47-68 (NB: matches on RAW values; only key *selection* uses
+normalization), ``_align_lists_by_key`` :71-151 (order from the longest source,
+then remaining keys sorted), the recursive core :156-347 (zip fallback for
+scalar lists :324-345), per-source view projection :474-516, and the public
+``recursive_align`` :350-431 whose signature matches the similarity aligner so
+it can swap in at the documented point (`consolidation.py:22`).
+"""
+
+from __future__ import annotations
+
+import logging
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .fuzzy import select_best_keys_with_fuzzy_fallback
+from .selection import CascadeConfig, select_best_keys
+
+logger = logging.getLogger(__name__)
+
+
+def _get_key_tuple(obj: Dict[str, Any], paths: Tuple[str, ...]) -> Optional[Tuple[Any, ...]]:
+    """Raw (un-normalized) key tuple; None if any path fails to resolve."""
+    values = []
+    for path in paths:
+        current: Any = obj
+        for part in path.split("."):
+            if isinstance(current, dict) and part in current:
+                current = current[part]
+            else:
+                return None
+        if current is None or isinstance(current, (dict, list)):
+            return None
+        values.append(current)
+    return tuple(values)
+
+
+def _align_lists_by_key(
+    lists_to_align: Sequence[Optional[List[Dict[str, Any]]]],
+    key_paths: Tuple[str, ...],
+) -> Tuple[List[List[Optional[Dict[str, Any]]]], List[List[Optional[int]]]]:
+    """Rows = key tuples (ordered by the longest source list, then sorted
+    leftovers); columns = sources. Returns (aligned_rows, original_indices)."""
+    if not any(lists_to_align):
+        return [], []
+
+    all_key_tuples: set = set()
+    indexes: List[Dict[Tuple[Any, ...], int]] = []
+    for source_list in lists_to_align:
+        mapping: Dict[Tuple[Any, ...], int] = {}
+        if isinstance(source_list, list):
+            for i, item in enumerate(source_list):
+                if isinstance(item, dict):
+                    key_tuple = _get_key_tuple(item, key_paths)
+                    if key_tuple is not None and key_tuple not in mapping:
+                        mapping[key_tuple] = i
+                        all_key_tuples.add(key_tuple)
+        indexes.append(mapping)
+
+    def _safe_len(source_list) -> int:
+        return len(source_list) if isinstance(source_list, list) else 0
+
+    best_source_idx = max(range(len(lists_to_align)), key=lambda i: _safe_len(lists_to_align[i]))
+    best_source_list = lists_to_align[best_source_idx]
+
+    ordered_keys: List[Tuple[Any, ...]] = []
+    seen_keys: set = set()
+    if isinstance(best_source_list, list):
+        for item in best_source_list:
+            if isinstance(item, dict):
+                key_tuple = _get_key_tuple(item, key_paths)
+                if key_tuple is not None and key_tuple not in seen_keys:
+                    ordered_keys.append(key_tuple)
+                    seen_keys.add(key_tuple)
+    ordered_keys.extend(sorted(all_key_tuples - seen_keys))
+
+    aligned_rows: List[List[Optional[Dict[str, Any]]]] = []
+    original_indices: List[List[Optional[int]]] = []
+    for key_tuple in ordered_keys:
+        row: List[Optional[Dict[str, Any]]] = []
+        indices_row: List[Optional[int]] = []
+        for source_idx, source_list in enumerate(lists_to_align):
+            original_idx = indexes[source_idx].get(key_tuple)
+            if original_idx is not None and isinstance(source_list, list):
+                row.append(source_list[original_idx])
+                indices_row.append(original_idx)
+            else:
+                row.append(None)
+                indices_row.append(None)
+        aligned_rows.append(row)
+        original_indices.append(indices_row)
+
+    return aligned_rows, original_indices
+
+
+def _select_key_paths(
+    lists: List[List[Any]], cascade_cfg: CascadeConfig
+) -> Optional[Tuple[str, ...]]:
+    """Standard selection (composite-aware) first; fuzzy preferred when it
+    improves stability; fuzzy-only as last resort."""
+    dummy_extractions = [{"items": lst} for lst in lists]
+    try:
+        result = select_best_keys(dummy_extractions, list_key="items", cascade_cfg=cascade_cfg)
+        use_composite = (
+            result.best_composite is not None
+            and result.best_composite.score_tuple > result.best_single.score_tuple
+        )
+        standard_paths = (
+            result.best_composite.path if use_composite else result.best_single.path
+        )
+        try:
+            comp = select_best_keys_with_fuzzy_fallback(
+                dummy_extractions,
+                cascade_cfg=cascade_cfg,
+                list_key="items",
+                fuzzy_numeric_round_decimals=2,
+                enable_fuzzy_fallback=True,
+                prefer_fuzzy_if_better=True,
+            )
+            if comp.chosen == "fuzzy" and comp.fuzzy_best is not None:
+                logger.debug("key-select: fuzzy path %s", comp.fuzzy_best.path)
+                return comp.fuzzy_best.path
+        except Exception:
+            pass
+        logger.debug("key-select: standard path %s", standard_paths)
+        return standard_paths
+    except ValueError:
+        try:
+            comp = select_best_keys_with_fuzzy_fallback(
+                dummy_extractions,
+                cascade_cfg=cascade_cfg,
+                list_key="items",
+                fuzzy_numeric_round_decimals=2,
+                enable_fuzzy_fallback=True,
+                prefer_fuzzy_if_better=True,
+            )
+            chosen = comp.fuzzy_best if comp.chosen == "fuzzy" else comp.normal_best
+            return chosen.path if chosen is not None else None
+        except Exception:
+            logger.debug("key-select: no key found")
+            return None
+
+
+def _compute_key_aligned_structure(
+    values: Sequence[Any],
+    original_paths: Sequence[Optional[str]],
+    cascade_cfg: CascadeConfig,
+) -> Tuple[Any, Dict[str, List[Optional[str]]]]:
+    """One merged aligned structure + mapping from aligned paths to per-source
+    original paths."""
+    if not values or all(v is None for v in values):
+        return None, {}
+
+    non_nulls = [v for v in values if v is not None]
+    if not non_nulls:
+        return None, {}
+
+    first_type = type(non_nulls[0])
+    is_same_type = all(isinstance(v, first_type) for v in non_nulls)
+    key_mappings: Dict[str, List[Optional[str]]] = {}
+
+    # Scalars / mixed types: first non-null value represents the column.
+    if not is_same_type or first_type not in (dict, list):
+        key_mappings[""] = list(original_paths)
+        return deepcopy(non_nulls[0]), key_mappings
+
+    if first_type is dict:
+        dicts = [v if isinstance(v, dict) else {} for v in values]
+        all_keys = sorted(set(key for d in dicts for key in d.keys()))
+
+        aligned_dict: Dict[str, Any] = {}
+        for key in all_keys:
+            values_for_key = [d.get(key) for d in dicts]
+            original_paths_for_key = [
+                (f"{p}.{key}" if p else key) if p is not None else None
+                for p in original_paths
+            ]
+            aligned_value, sub_mapping = _compute_key_aligned_structure(
+                values_for_key, original_paths_for_key, cascade_cfg
+            )
+            aligned_dict[key] = aligned_value
+            for sub_key, paths in sub_mapping.items():
+                key_mappings[f"{key}.{sub_key}" if sub_key else key] = paths
+        return aligned_dict, key_mappings
+
+    # first_type is list
+    lists = [v if isinstance(v, list) else [] for v in values]
+    is_list_of_dicts = all(
+        all(isinstance(item, dict) for item in lst) for lst in lists if lst
+    )
+
+    if is_list_of_dicts:
+        key_paths = _select_key_paths(lists, cascade_cfg)
+        if key_paths:
+            aligned_rows, original_indices = _align_lists_by_key(lists, key_paths)
+            aligned_list = []
+            for i, row in enumerate(aligned_rows):
+                original_paths_for_row = [
+                    (
+                        (f"{p}.{original_indices[i][j]}" if p else str(original_indices[i][j]))
+                        if (p is not None and original_indices[i][j] is not None)
+                        else None
+                    )
+                    for j, p in enumerate(original_paths)
+                ]
+                aligned_item, sub_mapping = _compute_key_aligned_structure(
+                    row, original_paths_for_row, cascade_cfg
+                )
+                aligned_list.append(aligned_item)
+                for sub_key, paths in sub_mapping.items():
+                    key_mappings[f"{i}.{sub_key}" if sub_key else str(i)] = paths
+            return aligned_list, key_mappings
+
+    # Zip fallback for scalar lists / failed key selection.
+    logger.debug("key-align: zip fallback")
+    aligned_list = []
+    max_len = max(len(lst) for lst in lists) if lists else 0
+    for i in range(max_len):
+        row = [lst[i] if i < len(lst) else None for lst in lists]
+        original_paths_for_row = [
+            ((f"{p}.{i}" if p else str(i)) if i < len(values[j]) else None)
+            if p is not None
+            else None
+            for j, p in enumerate(original_paths)
+        ]
+        aligned_item, sub_mapping = _compute_key_aligned_structure(
+            row, original_paths_for_row, cascade_cfg
+        )
+        aligned_list.append(aligned_item)
+        for sub_key, paths in sub_mapping.items():
+            key_mappings[f"{i}.{sub_key}" if sub_key else str(i)] = paths
+    return aligned_list, key_mappings
+
+
+def _get_value_by_path(obj: Any, path: Optional[str]) -> Any:
+    """Dot-path lookup with integer list indices; '' is the root."""
+    if path is None:
+        return None
+    if path == "":
+        return obj
+    cur = obj
+    for token in path.split("."):
+        if token == "":
+            continue
+        try:
+            idx = int(token)
+        except ValueError:
+            idx = None
+        if idx is not None:
+            if isinstance(cur, list) and 0 <= idx < len(cur):
+                cur = cur[idx]
+                continue
+            return None
+        if isinstance(cur, dict) and token in cur:
+            cur = cur[token]
+        else:
+            return None
+    return cur
+
+
+def _materialize_source_view(
+    aligned_node: Any,
+    key_mappings: Dict[str, List[Optional[str]]],
+    source_idx: int,
+    current_path: str = "",
+    source_root: Optional[Dict[str, Any]] = None,
+) -> Any:
+    """Project the merged structure back into one source's values via the
+    path mappings (None where that source contributed nothing)."""
+    if source_root is None:
+        raise ValueError("source_root must be provided at the top-level call.")
+
+    if isinstance(aligned_node, dict):
+        return {
+            k: _materialize_source_view(
+                v, key_mappings, source_idx, f"{current_path}.{k}" if current_path else k, source_root
+            )
+            for k, v in aligned_node.items()
+        }
+
+    if isinstance(aligned_node, list):
+        return [
+            _materialize_source_view(
+                v, key_mappings, source_idx, f"{current_path}.{i}" if current_path else str(i), source_root
+            )
+            for i, v in enumerate(aligned_node)
+        ]
+
+    mapped_paths = key_mappings.get(current_path)
+    if mapped_paths is not None and 0 <= source_idx < len(mapped_paths):
+        return _get_value_by_path(source_root, mapped_paths[source_idx])
+    return deepcopy(aligned_node)
+
+
+def recursive_align(
+    values: Sequence[Any],
+    string_similarity_method: str = "levenshtein",
+    min_support_ratio: float = 0.5,
+    max_novelty_ratio: float = 0.25,
+    current_path: str = "",
+    reference_idx: Optional[int] = None,
+    min_uniqueness: Optional[float] = None,
+    min_coverage: Optional[float] = None,
+) -> Tuple[Sequence[Any], Dict[str, List[Optional[str]]]]:
+    """Key-based recursive alignment with the similarity aligner's API.
+
+    ``string_similarity_method``/``max_novelty_ratio``/``reference_idx`` are
+    accepted for signature parity (the reference ignores them too).
+    """
+    if not values:
+        return list(values), {}
+    if all(v is None for v in values):
+        return list(values), {current_path: [current_path for _ in values]}
+
+    non_nulls = [v for v in values if v is not None]
+    if not non_nulls:
+        return list(values), {}
+
+    eff_min_coverage = min_coverage if min_coverage is not None else min_support_ratio
+    eff_min_uniqueness = min_uniqueness if min_uniqueness is not None else 0.5
+    cascade_cfg = CascadeConfig(
+        min_coverage=eff_min_coverage, min_uniqueness=eff_min_uniqueness
+    )
+
+    original_paths: List[Optional[str]] = [current_path for _ in values]
+    aligned_data, raw_key_mappings = _compute_key_aligned_structure(
+        values, original_paths, cascade_cfg
+    )
+
+    per_source_outputs: List[Any] = []
+    for i, src_root in enumerate(values):
+        if isinstance(src_root, dict):
+            materialized_root: Dict[str, Any] = src_root
+        elif isinstance(src_root, list):
+            materialized_root = {"items": src_root}
+            # NB: reference parity — the "items." rewrite mutates the shared
+            # mapping inside the source loop (:398-400), so list-valued roots
+            # with multiple sources double-prefix. The wired swap point only
+            # ever passes dict roots, where this path is never taken.
+            if raw_key_mappings:
+                raw_key_mappings = {
+                    (f"items.{k}" if k else "items"): v for k, v in raw_key_mappings.items()
+                }
+        else:
+            materialized_root = {}
+        per_source_outputs.append(
+            _materialize_source_view(
+                aligned_node=aligned_data,
+                key_mappings=raw_key_mappings,
+                source_idx=i,
+                current_path="",
+                source_root=materialized_root,
+            )
+        )
+
+    if current_path:
+        prefixed: Dict[str, List[Optional[str]]] = {}
+        for key, paths in raw_key_mappings.items():
+            pref_key = f"{current_path}.{key}" if key else current_path
+            pref_paths: List[Optional[str]] = []
+            for p in paths:
+                if p is None or p == "":
+                    pref_paths.append(current_path if current_path else None)
+                else:
+                    pref_paths.append(f"{current_path}.{p}" if current_path else p)
+            prefixed[pref_key] = pref_paths
+        return per_source_outputs, prefixed
+    return per_source_outputs, raw_key_mappings

@@ -1,0 +1,94 @@
+"""Fuzzy key selection: canonicalized scalars (rounded numerics, normalized
+strings), preferred over standard selection iff stability strictly improves.
+
+Parity target: `/root/reference/k_llms/utils/fuzzy_key_selection.py` —
+canonicalization :37-52, fuzzy cascade :100-157 (here the shared parametrized
+funnel from selection.py), comparison/decision :175-232.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict
+
+from .selection import (
+    CascadeConfig,
+    KeyMetrics,
+    cascade_select_keys,
+    discover_scalar_paths,
+    normalize_scalar,
+    select_best_keys,
+    stability_tuple,
+)
+
+
+def canonicalize_scalar(value: Any, numeric_round_decimals: int = 2) -> Any:
+    """Numbers rounded to N decimals; strings lower/trim/collapse; rest as-is."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        try:
+            return round(float(value), numeric_round_decimals)
+        except Exception:
+            return value
+    if isinstance(value, str):
+        return normalize_scalar(value)
+    return value
+
+
+class SelectionComparison(BaseModel):
+    """Which strategy won: "normal" | "fuzzy"."""
+
+    model_config = ConfigDict(frozen=True)
+
+    normal_best: Optional[KeyMetrics]
+    fuzzy_best: Optional[KeyMetrics]
+    chosen: str
+
+
+def select_best_keys_with_fuzzy_fallback(
+    extractions: List[Dict[str, Any]],
+    cascade_cfg: CascadeConfig = CascadeConfig(),
+    list_key: Optional[str] = None,
+    fuzzy_numeric_round_decimals: int = 2,
+    enable_fuzzy_fallback: bool = True,
+    prefer_fuzzy_if_better: bool = True,
+) -> SelectionComparison:
+    normal_best: Optional[KeyMetrics] = None
+    try:
+        normal_best = select_best_keys(
+            extractions, cascade_cfg=cascade_cfg, list_key=list_key
+        ).best_single
+    except ValueError:
+        normal_best = None
+
+    fuzzy_best: Optional[KeyMetrics] = None
+    if enable_fuzzy_fallback:
+        candidates = discover_scalar_paths(extractions, list_key=list_key)
+        if candidates:
+            try:
+                fuzzy_best = cascade_select_keys(
+                    extractions,
+                    candidates,
+                    cascade_cfg,
+                    list_key=list_key,
+                    canonicalize=lambda v: canonicalize_scalar(
+                        v, fuzzy_numeric_round_decimals
+                    ),
+                ).final_best
+            except ValueError:
+                fuzzy_best = None
+
+    if normal_best is None and fuzzy_best is None:
+        raise ValueError("No keys pass Stage 0 (normal or fuzzy)")
+
+    if normal_best is not None and (not enable_fuzzy_fallback or fuzzy_best is None):
+        return SelectionComparison(normal_best=normal_best, fuzzy_best=None, chosen="normal")
+
+    if normal_best is None:
+        return SelectionComparison(normal_best=None, fuzzy_best=fuzzy_best, chosen="fuzzy")
+
+    if prefer_fuzzy_if_better and stability_tuple(fuzzy_best) > stability_tuple(normal_best):
+        return SelectionComparison(
+            normal_best=normal_best, fuzzy_best=fuzzy_best, chosen="fuzzy"
+        )
+    return SelectionComparison(normal_best=normal_best, fuzzy_best=fuzzy_best, chosen="normal")

@@ -134,3 +134,43 @@ def load_reference_engine():
     _load("majority_sorting")
     _cached = _load("consensus_utils")
     return _cached
+
+
+_keyalign_cached = None
+
+
+def load_reference_keyalign():
+    """Returns (key_selection, fuzzy_key_selection, key_based_alignment) from
+    the reference tree (they only need pydantic + each other)."""
+    global _keyalign_cached
+    if _keyalign_cached is not None:
+        return _keyalign_cached
+    if not reference_available():
+        raise RuntimeError("reference tree not available")
+
+    _install_stub_modules()
+
+    utils_dir = os.path.join(REFERENCE_ROOT, "k_llms", "utils")
+    pkg_name = "_reference_oracle_utils"
+    if pkg_name not in sys.modules:
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [utils_dir]
+        sys.modules[pkg_name] = pkg
+
+    def _load(mod_name: str):
+        full = f"{pkg_name}.{mod_name}"
+        if full in sys.modules:
+            return sys.modules[full]
+        spec = importlib.util.spec_from_file_location(
+            full, os.path.join(utils_dir, f"{mod_name}.py")
+        )
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[full] = module
+        spec.loader.exec_module(module)
+        return module
+
+    ks = _load("key_selection")
+    fz = _load("fuzzy_key_selection")
+    kb = _load("key_based_alignment")
+    _keyalign_cached = (ks, fz, kb)
+    return _keyalign_cached

@@ -1,0 +1,203 @@
+"""Key-based aligner: unit behavior + differential parity vs the reference."""
+
+import random
+
+import pytest
+
+from reference_oracle import load_reference_keyalign, reference_available
+from k_llms_tpu.keyalign import (
+    CascadeConfig,
+    recursive_align,
+    select_best_keys,
+    select_best_keys_with_fuzzy_fallback,
+)
+from k_llms_tpu.keyalign.align import _align_lists_by_key, _get_key_tuple
+from k_llms_tpu.keyalign.selection import discover_scalar_paths, normalize_scalar
+
+
+def test_normalize_scalar():
+    assert normalize_scalar("  Hello   World ") == "hello world"
+    assert normalize_scalar(3.5) == 3.5
+
+
+def test_discover_scalar_paths():
+    ex = {"products": [{"sku": "a", "meta": {"color": "red"}, "tags": ["x"]}]}
+    assert discover_scalar_paths([ex]) == ["meta.color", "sku"]
+
+
+def test_get_key_tuple_raw_values():
+    obj = {"sku": "ABC", "meta": {"n": 2}}
+    assert _get_key_tuple(obj, ("sku", "meta.n")) == ("ABC", 2)
+    assert _get_key_tuple(obj, ("missing",)) is None
+    assert _get_key_tuple({"sku": None}, ("sku",)) is None
+
+
+def test_align_lists_by_key_basic():
+    lists = [
+        [{"sku": "a", "qty": 1}, {"sku": "b", "qty": 2}],
+        [{"sku": "b", "qty": 2}, {"sku": "a", "qty": 1}, {"sku": "c", "qty": 3}],
+    ]
+    rows, idx = _align_lists_by_key(lists, ("sku",))
+    # order follows the longest source (list 1): b, a, c
+    assert [r[1]["sku"] if r[1] else None for r in rows] == ["b", "a", "c"]
+    assert [r[0]["sku"] if r[0] else None for r in rows] == ["b", "a", None]
+    assert idx[0] == [1, 0]
+
+
+def test_select_best_keys_picks_stable_unique_key():
+    extractions = [
+        {"products": [{"sku": "a", "price": 1.0, "cat": "x"}, {"sku": "b", "price": 2.0, "cat": "x"}]},
+        {"products": [{"sku": "b", "price": 2.0, "cat": "x"}, {"sku": "a", "price": 1.01, "cat": "x"}]},
+    ]
+    # With no uniqueness gate the union-size parsimony stage prefers the
+    # constant "cat" key (reference behavior, verified by the parity tests);
+    # gating constants out selects the real join key.
+    result = select_best_keys(extractions)
+    assert result.best_single.path == ("cat",)
+    gated = select_best_keys(extractions, cascade_cfg=CascadeConfig(min_uniqueness=0.2))
+    assert gated.best_single.path == ("sku",)
+
+
+def test_fuzzy_preferred_on_jittery_numbers():
+    # price differs slightly across extractions -> fuzzy (rounded) is more stable
+    extractions = [
+        {"products": [{"price": 1.291}, {"price": 2.502}]},
+        {"products": [{"price": 1.293}, {"price": 2.498}]},
+    ]
+    comp = select_best_keys_with_fuzzy_fallback(extractions)
+    assert comp.chosen == "fuzzy"
+
+
+def test_recursive_align_swap_signature():
+    values = [
+        {"items": [{"sku": "a", "v": 1}, {"sku": "b", "v": 2}]},
+        {"items": [{"sku": "b", "v": 2}, {"sku": "a", "v": 1}]},
+    ]
+    aligned, mappings = recursive_align(values, "levenshtein", 0.5)
+    assert len(aligned) == 2
+    # both sources see the same item order after alignment
+    assert [d["sku"] for d in aligned[0]["items"]] == [d["sku"] for d in aligned[1]["items"]]
+    assert mappings  # traceability paths present
+
+
+# ---------------- differential parity vs the reference ----------------
+
+pytestmark_ref = pytest.mark.skipif(
+    not reference_available(), reason="reference tree not mounted"
+)
+
+SKUS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+CATS = ["tools", "toys", "food"]
+
+
+def _record(rng):
+    return {
+        "sku": rng.choice(SKUS),
+        "name": rng.choice(SKUS) + " item",
+        "price": round(rng.uniform(1, 50), rng.choice([2, 3])),
+        "qty": rng.randint(1, 9),
+        "meta": {"cat": rng.choice(CATS), "rank": rng.randint(1, 100)},
+    }
+
+
+def _extraction(rng, n_records):
+    recs = []
+    seen = set()
+    for _ in range(n_records):
+        r = _record(rng)
+        if r["sku"] in seen:
+            continue
+        seen.add(r["sku"])
+        recs.append(r)
+    return {"products": recs}
+
+
+def _perturbed_family(seed):
+    rng = random.Random(seed)
+    base = _extraction(rng, rng.randint(2, 5))
+    out = [base]
+    for _ in range(rng.randint(1, 3)):
+        import copy
+
+        e = copy.deepcopy(base)
+        for rec in e["products"]:
+            if rng.random() < 0.4:
+                rec["price"] = round(rec["price"] + rng.uniform(-0.004, 0.004), 4)
+            if rng.random() < 0.2:
+                rec["qty"] += 1
+            if rng.random() < 0.2:
+                rec["name"] = rec["name"].upper()
+        rng.shuffle(e["products"])
+        if rng.random() < 0.3 and e["products"]:
+            e["products"].pop()
+        out.append(e)
+    return out
+
+
+def _metrics_key(m):
+    return (tuple(m.path), m.score_tuple)
+
+
+@pytestmark_ref
+@pytest.mark.parametrize("seed", range(15))
+def test_parity_select_best_keys(seed):
+    ks, _, _ = load_reference_keyalign()
+    extractions = _perturbed_family(seed)
+    try:
+        ref = ks.select_best_keys(extractions)
+        ref_err = None
+    except ValueError as e:
+        ref, ref_err = None, str(e)
+    try:
+        ours = select_best_keys(extractions)
+        our_err = None
+    except ValueError as e:
+        ours, our_err = None, str(e)
+    assert (ref is None) == (ours is None)
+    if ref is None:
+        return
+    assert _metrics_key(ref.best_single) == _metrics_key(ours.best_single)
+    assert (ref.best_composite is None) == (ours.best_composite is None)
+    if ref.best_composite is not None:
+        assert _metrics_key(ref.best_composite) == _metrics_key(ours.best_composite)
+
+
+@pytestmark_ref
+@pytest.mark.parametrize("seed", range(15))
+def test_parity_fuzzy_selection(seed):
+    _, fz, _ = load_reference_keyalign()
+    extractions = _perturbed_family(100 + seed)
+    try:
+        ref = fz.select_best_keys_with_fuzzy_fallback(extractions)
+        ref_err = None
+    except ValueError:
+        ref, ref_err = None, True
+    try:
+        ours = select_best_keys_with_fuzzy_fallback(extractions)
+        our_err = None
+    except ValueError:
+        ours, our_err = None, True
+    assert (ref is None) == (ours is None)
+    if ref is None:
+        return
+    assert ref.chosen == ours.chosen
+    if ref.fuzzy_best is not None:
+        assert ours.fuzzy_best is not None
+        assert _metrics_key(ref.fuzzy_best) == _metrics_key(ours.fuzzy_best)
+
+
+@pytestmark_ref
+@pytest.mark.parametrize("seed", range(15))
+def test_parity_recursive_align(seed):
+    _, _, kb = load_reference_keyalign()
+    rng = random.Random(500 + seed)
+    values = []
+    family = _perturbed_family(500 + seed)
+    for e in family:
+        values.append({"doc": {"items": e["products"], "status": rng.choice(CATS)}})
+    import copy
+
+    ref_aligned, ref_map = kb.recursive_align(copy.deepcopy(values), "levenshtein", 0.5)
+    our_aligned, our_map = recursive_align(copy.deepcopy(values), "levenshtein", 0.5)
+    assert list(ref_aligned) == list(our_aligned), f"seed={seed}"
+    assert ref_map == our_map, f"seed={seed}"
