@@ -27,6 +27,10 @@ class ModelConfig:
     rms_eps: float = 1e-5
     max_seq_len: int = 8192
     dtype: str = "bfloat16"
+    # Prefill attention implementation: "xla" (einsum, runs anywhere) or
+    # "flash" (Pallas TPU kernel, ops/attention.py; ~1.3x prefill attention
+    # speedup at 2k context on v5e). Decode always uses the XLA path (Sq=1).
+    attention_impl: str = "xla"
     # byte tokenizer vocab fits any vocab_size >= 260; HF tokenizers use the full space
     bos_token_id: int = 256
     eos_token_id: int = 257

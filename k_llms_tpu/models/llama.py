@@ -160,6 +160,7 @@ def _block(
     key_mask: jax.Array,
     prefix_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
     prefix_mask: Optional[jax.Array] = None,
+    key_lengths: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One transformer block over (possibly cached) keys.
 
@@ -191,6 +192,34 @@ def _block(
         cache_v = lax.dynamic_update_slice_in_dim(
             cache_v, v.astype(cache_v.dtype), write_index, axis=1
         )
+
+    # Full-sequence prefill can take the Pallas flash path: prefix-length
+    # masking + causal structure are exactly what the kernel supports.
+    if (
+        config.attention_impl == "flash"
+        and write_index is None
+        and prefix_kv is None
+        and key_lengths is not None
+    ):
+        from ..ops.attention import flash_attention
+
+        attn = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=True,
+            key_lengths=key_lengths,
+            sm_scale=scale,
+            interpret=jax.default_backend() != "tpu",
+        ).transpose(0, 2, 1, 3)
+        attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
+        x = x + attn @ layer["wo"]
+
+        h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+        gate = jax.nn.silu(h @ layer["w_gate"])
+        up = h @ layer["w_up"]
+        x = x + (gate * up) @ layer["w_down"]
+        return x, (cache_k, cache_v)
 
     scores = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, Smax] f32
     neg = jnp.finfo(jnp.float32).min
@@ -228,6 +257,7 @@ def _apply_stack(
     key_mask: jax.Array,
     prefix: Optional[KVCache] = None,
     prefix_mask: Optional[jax.Array] = None,
+    key_lengths: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Scan the layer stack. cache k/v: [L, B, Smax, KVH, D]."""
 
@@ -247,6 +277,7 @@ def _apply_stack(
             key_mask,
             prefix_kv=prefix_kv,
             prefix_mask=prefix_mask,
+            key_lengths=key_lengths,
         )
         return x, new_kv
 
@@ -292,7 +323,10 @@ def forward(
     key_mask = causal[None, :, :] & pad_mask[:, None, :].astype(bool)
 
     cache = init_cache(config, B, S)
-    x, _ = _apply_stack(config, params, x, positions, cache, None, key_mask)
+    key_lengths = pad_mask.astype(jnp.int32).sum(axis=1)
+    x, _ = _apply_stack(
+        config, params, x, positions, cache, None, key_mask, key_lengths=key_lengths
+    )
     h = rms_norm(x, params["final_norm"], config.rms_eps)
     logits = (h @ params["lm_head"]).astype(jnp.float32)
     return logits, h
@@ -316,7 +350,10 @@ def prefill(
     key_mask = causal[None, :, :] & valid[:, None, :]
 
     cache = init_cache(config, B, S)
-    x, cache = _apply_stack(config, params, x, positions, cache, None, key_mask)
+    key_lengths = jnp.broadcast_to(prompt_len, (B,)).astype(jnp.int32)
+    x, cache = _apply_stack(
+        config, params, x, positions, cache, None, key_mask, key_lengths=key_lengths
+    )
     h = rms_norm(x, params["final_norm"], config.rms_eps)
     last = jnp.take_along_axis(h, (prompt_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1)
     logits = (last[:, 0, :] @ params["lm_head"]).astype(jnp.float32)

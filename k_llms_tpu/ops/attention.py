@@ -1,0 +1,196 @@
+"""Flash attention for TPU (Pallas) with an XLA reference path.
+
+The prefill hot loop is a classic flash-attention pattern: tile Q and K/V into
+VMEM blocks, keep running max/sum/accumulator scratch across the K grid axis
+(TPU grids execute sequentially, so scratch persists), and never materialize
+the [Sq, Sk] score matrix in HBM. GQA is handled by mapping each query head's
+K/V BlockSpec onto its shared kv head — no head replication in memory.
+
+`attention_xla` is the always-available reference implementation (also the
+numerical oracle in tests, where the kernel runs in interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    key_mask: Optional[jax.Array] = None,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference attention. q: [B, QH, Sq, D]; k/v: [B, KVH, Sk, D];
+    key_mask: [B, Sk] booleans. Returns [B, QH, Sq, D] (f32)."""
+    B, QH, Sq, D = q.shape
+    KVH = k.shape[1]
+    G = QH // KVH
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    qg = q.reshape(B, KVH, G, Sq, D)
+    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    Sk = k.shape[2]
+    if causal:
+        cmask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        scores = jnp.where(cmask[None, None, None], scores, NEG_INF)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, None, :].astype(bool), scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", weights, v.astype(jnp.float32))
+    return out.reshape(B, QH, Sq, D)
+
+
+def _flash_kernel(
+    keylen_ref,  # [B, 1] int32 in SMEM: valid (prefix) key count per batch row
+    q_ref,  # [1, 1, block_q, D]
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    o_ref,  # [1, 1, block_q, D]
+    acc_ref,  # VMEM scratch [block_q, D] f32
+    m_ref,  # VMEM scratch [block_q, 1] f32 running max
+    l_ref,  # VMEM scratch [block_q, 1] f32 running sum
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+):
+    bi = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale  # [block_q, block_k]
+
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        valid = cols < keylen_ref[bi, 0]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, cols <= rows)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Renormalize the old accumulator, fold in the new block.
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    if causal:
+        # Skip K blocks entirely above the causal diagonal.
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    key_lengths: Optional[jax.Array] = None,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas flash attention. q: [B, QH, Sq, D]; k/v: [B, KVH, Sk, D];
+    key_lengths: [B] int32 — keys at positions >= length are masked (the
+    padding pattern our engine produces; a prefix length rides SMEM where an
+    arbitrary mask array would break TPU tiling). Returns [B, QH, Sq, D].
+
+    Sq/Sk pad to block multiples internally; GQA maps query head h onto kv head
+    h // (QH // KVH) via the BlockSpec index maps.
+    """
+    B, QH, Sq, D = q.shape
+    KVH, Sk = k.shape[1], k.shape[2]
+    G = QH // KVH
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    block_q = max(8, min(block_q, Sq))
+    block_k = max(8, min(block_k, Sk))
+    Sq_pad = pl.cdiv(Sq, block_q) * block_q
+    Sk_pad = pl.cdiv(Sk, block_k) * block_k
+
+    if key_lengths is None:
+        key_lengths = jnp.full((B,), Sk, jnp.int32)
+    key_lengths = key_lengths.astype(jnp.int32).reshape(B, 1)
+    if Sk_pad != Sk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
+    if Sq_pad != Sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, Sq_pad - Sq), (0, 0)))
+
+    grid = (B, QH, Sq_pad // block_q, Sk_pad // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, QH, Sq_pad, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda b, h, qi, ki: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(key_lengths, q, k, v)
+
+    return out[:, :, :Sq, :]

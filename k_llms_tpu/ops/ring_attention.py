@@ -1,0 +1,126 @@
+"""Ring attention: exact sequence-parallel attention over a mesh axis.
+
+Long-context support the reference cannot have (its sequence length is the
+provider's problem, SURVEY.md §5): shard the sequence across devices, keep Q
+local, and rotate K/V chunks around the ring with ``ppermute`` while
+accumulating flash-style online softmax state. Every chunk transfer overlaps a
+compute step and rides ICI; memory per device is O(S/P), so context scales
+linearly with the ring size.
+
+Causality is handled with global positions: device d owns query positions
+[d*S_local, (d+1)*S_local); at ring step i it holds the K/V chunk of device
+(d - i) mod P.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _chunk_attention_update(q, k, v, q_pos, k_pos, causal, scale, acc, m, l):
+    """One online-softmax accumulation step against a K/V chunk.
+
+    q: [B, QH, Sq, D]; k/v: [B, KVH, Sk, D]; q_pos/k_pos: global positions.
+    acc: [B, QH, Sq, D] f32; m/l: [B, QH, Sq, 1] f32.
+    """
+    B, QH, Sq, D = q.shape
+    KVH = k.shape[1]
+    G = QH // KVH
+
+    qg = q.reshape(B, KVH, G, Sq, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    s = (s * scale).reshape(B, QH, Sq, -1)
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pg = p.reshape(B, KVH, G, Sq, -1)
+    delta = jnp.einsum("bhgqk,bhkd->bhgqd", pg, v.astype(jnp.float32)).reshape(
+        B, QH, Sq, D
+    )
+    acc_new = acc * alpha + delta
+    return acc_new, m_new, l_new
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard body (call inside shard_map). q: [B, QH, S_local, D];
+    k/v: [B, KVH, S_local, D] — all sharded on the sequence axis."""
+    B, QH, S_local, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    p_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    q_pos = my_idx * S_local + jnp.arange(S_local)
+
+    # pvary: the accumulators start identical on every device but become
+    # device-varying inside the loop; shard_map's axis typing requires the
+    # carry to be marked varying up front.
+    acc0 = lax.pvary(jnp.zeros((B, QH, S_local, D), jnp.float32), (axis_name,))
+    m0 = lax.pvary(jnp.full((B, QH, S_local, 1), NEG_INF, jnp.float32), (axis_name,))
+    l0 = lax.pvary(jnp.zeros((B, QH, S_local, 1), jnp.float32), (axis_name,))
+
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def step(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (my_idx - i) % p_size
+        k_pos = src * S_local + jnp.arange(S_local)
+        acc, m, l = _chunk_attention_update(
+            q, k_cur, v_cur, q_pos, k_pos, causal, scale, acc, m, l
+        )
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m, l, k_cur, v_cur)
+
+    acc, m, l, _, _ = lax.fori_loop(0, p_size, step, (acc0, m0, l0, k, v))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l).astype(q.dtype)
+
+
+def ring_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str = "data",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """shard_map wrapper: q [B, QH, S, D], k/v [B, KVH, S, D] with S sharded
+    over ``seq_axis``. Exact (same result as full attention), memory O(S/P)."""
+    spec = P(None, None, seq_axis, None)
+
+    fn = functools.partial(
+        ring_attention_local, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+    )
+    sharded = jax.shard_map(
+        lambda q, k, v: fn(q, k, v),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return sharded(q, k, v)
