@@ -15,6 +15,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+from pydantic import BaseModel
 
 from ..consensus.prompts import SYSTEM_PROMPT_STRING_CONSENSUS_LLM
 from ..engine.engine import LocalEngine
@@ -27,35 +28,59 @@ from .base import Backend, ChatRequest
 MAX_EMBEDDING_TOKENS = 8191
 
 
+class BackendConfig(BaseModel):
+    """Engine configuration (the pydantic-settings pattern of the reference's
+    ConsensusSettings, SURVEY.md §5 "Config/flag system"), extended with the
+    device-side knobs the reference never needed."""
+
+    model: str = "tiny"
+    checkpoint_path: Optional[str] = None
+    tokenizer_path: Optional[str] = None
+    model_parallel: Optional[int] = None  # TP degree (mesh "model" axis)
+    max_new_tokens: int = 256
+    param_seed: int = 0
+    # Model-config overrides
+    dtype: Optional[str] = None  # e.g. "bfloat16" | "float32"
+    max_seq_len: Optional[int] = None
+    attention_impl: Optional[str] = None  # "xla" | "flash"
+
+
 class TpuBackend(Backend):
     def __init__(
         self,
         model: str = "tiny",
-        checkpoint_path: Optional[str] = None,
-        tokenizer_path: Optional[str] = None,
+        config: Optional[BackendConfig] = None,
         mesh=None,
-        model_parallel: Optional[int] = None,
-        max_new_tokens: int = 256,
-        param_seed: int = 0,
         engine: Optional[LocalEngine] = None,
-        **_: Any,
+        **kwargs: Any,
     ):
-        self.model_name = model
-        config = get_config(model)
-        self.tokenizer = get_tokenizer(tokenizer_path)
+        cfg = config or BackendConfig(model=model, **{
+            k: v for k, v in kwargs.items() if k in BackendConfig.model_fields
+        })
+        self.backend_config = cfg
+        self.model_name = cfg.model
+        model_config = get_config(cfg.model)
+        overrides = {
+            k: getattr(cfg, k)
+            for k in ("dtype", "max_seq_len", "attention_impl")
+            if getattr(cfg, k) is not None
+        }
+        if overrides:
+            model_config = model_config.with_(**overrides)
+        self.tokenizer = get_tokenizer(cfg.tokenizer_path)
         params = None
-        if checkpoint_path:
+        if cfg.checkpoint_path:
             from ..models.loader import load_checkpoint
 
-            params = load_checkpoint(checkpoint_path, config)
+            params = load_checkpoint(cfg.checkpoint_path, model_config)
         self.engine = engine or LocalEngine(
-            config,
+            model_config,
             params=params,
             mesh=mesh,
-            model_parallel=model_parallel,
-            param_seed=param_seed,
+            model_parallel=cfg.model_parallel,
+            param_seed=cfg.param_seed,
         )
-        self.default_max_new_tokens = max_new_tokens
+        self.default_max_new_tokens = cfg.max_new_tokens
 
     # -- chat -------------------------------------------------------------
     def chat_completion(self, request: ChatRequest) -> ChatCompletion:

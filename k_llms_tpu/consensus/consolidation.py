@@ -59,11 +59,33 @@ def _format_consensus_content(consensus_content: Optional[Dict[str, Any]]) -> st
     return json.dumps(consensus_content)
 
 
+def _sample_weights(choices, contents_mask: List[bool]) -> Optional[List[float]]:
+    """Softmax of per-sample sequence logprobs (the engine attaches
+    ``sample_logprob`` to each choice); None when any sample lacks one."""
+    logprobs = []
+    for choice, used in zip(choices, contents_mask):
+        if not used:
+            continue
+        lp = getattr(choice, "sample_logprob", None)
+        if lp is None:
+            return None
+        logprobs.append(float(lp))
+    if not logprobs:
+        return None
+    import math
+
+    mx = max(logprobs)
+    exps = [math.exp(lp - mx) for lp in logprobs]
+    total = sum(exps)
+    return [e / total for e in exps]
+
+
 def _consensus_over_contents(
     contents: List[Dict[str, Any]],
     scorer: SimilarityScorer,
     consensus_settings: ConsensusSettings,
     llm_consensus_fn: Optional[LlmConsensusFn],
+    weights: Optional[List[float]] = None,
 ):
     """Shared align-then-vote step over parsed choice contents."""
     if len(contents) >= 2:
@@ -89,6 +111,7 @@ def _consensus_over_contents(
         consensus_settings,
         scorer,
         llm_consensus_fn=llm_consensus_fn,
+        weights=weights if consensus_settings.likelihood_weighting else None,
     )
 
 
@@ -108,12 +131,19 @@ def consolidate_chat_completions(
             return KLLMsChatCompletion.model_validate(completion.model_dump())
 
         choice_contents: List[Dict[str, Any]] = []
+        used_mask: List[bool] = []
         for choice in completion.choices:
-            if choice.message.content:
+            used = bool(choice.message.content)
+            used_mask.append(used)
+            if used:
                 choice_contents.append(_safe_parse_content(choice.message.content))
 
         consensus_content, likelihoods = _consensus_over_contents(
-            choice_contents, scorer, consensus_settings, llm_consensus_fn
+            choice_contents,
+            scorer,
+            consensus_settings,
+            llm_consensus_fn,
+            weights=_sample_weights(completion.choices, used_mask),
         )
 
         content_str = _format_consensus_content(consensus_content)
@@ -130,8 +160,10 @@ def consolidate_chat_completions(
             message=consolidated_message,
             logprobs=completion.choices[0].logprobs if completion.choices else None,
         )
+        # Rebuild from dumps so extension fields (e.g. the engine's
+        # sample_logprob) survive re-indexing.
         individual_choices = [
-            Choice(finish_reason=c.finish_reason, index=i + 1, message=c.message, logprobs=c.logprobs)
+            Choice.model_validate({**c.model_dump(), "index": i + 1})
             for i, c in enumerate(completion.choices)
         ]
         all_choices = [consolidated_choice] + individual_choices
@@ -180,12 +212,7 @@ def consolidate_chat_completions(
     for i, completion in enumerate(completion_list):
         if completion.choices:
             individual_choices.append(
-                Choice(
-                    finish_reason=completion.choices[0].finish_reason,
-                    index=i + 1,
-                    message=completion.choices[0].message,
-                    logprobs=completion.choices[0].logprobs,
-                )
+                Choice.model_validate({**completion.choices[0].model_dump(), "index": i + 1})
             )
     all_choices = [consolidated_choice] + individual_choices
 
@@ -214,12 +241,19 @@ def consolidate_parsed_chat_completions(
         return KLLMsParsedChatCompletion.model_validate(completion.model_dump())
 
     parsed_choice_contents: List[Dict[str, Any]] = []
+    used_mask: List[bool] = []
     for choice in completion.choices:
-        if choice.message.content:
+        used = bool(choice.message.content)
+        used_mask.append(used)
+        if used:
             parsed_choice_contents.append(_safe_parse_content(choice.message.content))
 
     consensus_content, likelihoods = _consensus_over_contents(
-        parsed_choice_contents, scorer, consensus_settings, llm_consensus_fn
+        parsed_choice_contents,
+        scorer,
+        consensus_settings,
+        llm_consensus_fn,
+        weights=_sample_weights(completion.choices, used_mask),
     )
 
     parsed_consensus = None

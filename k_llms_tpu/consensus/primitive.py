@@ -30,12 +30,59 @@ from .similarity import SimilarityScorer
 LlmConsensusFn = Callable[[List[str]], str]
 
 
+def _weighted_numeric_consensus(
+    xs: List[float], ws: List[float], total_weight: float, settings: ConsensusSettings
+) -> Tuple[float, float]:
+    """Weighted 1-D clustering: cluster mass = sum of member weights; the
+    heaviest cluster wins and its weighted mean represents it."""
+    pairs = sorted(zip(xs, ws))
+
+    def _is_close(a: float, b: float) -> bool:
+        denom = max(abs(a), abs(b), 1.0)
+        return abs(b - a) <= max(settings.abs_eps, settings.rel_eps * denom)
+
+    clusters: List[List[Tuple[float, float]]] = [[pairs[0]]]
+    for prev, cur in zip(pairs, pairs[1:]):
+        if _is_close(prev[0], cur[0]):
+            clusters[-1].append(cur)
+        else:
+            clusters.append([cur])
+
+    def mass(c):
+        return sum(w for _, w in c)
+
+    best = max(clusters, key=mass)
+    m = mass(best)
+    rep = sum(x * w for x, w in best) / m
+    return rep, round(m / total_weight, 5)
+
+
+def _weighted_medoid(
+    values: List[Any], ws: List[float], scorer: SimilarityScorer, parent_valid_frac: float
+) -> Tuple[Any, float]:
+    """Medoid under weighted mean similarity (self excluded)."""
+    n = len(values)
+    sim = np.zeros((n, n))
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim[i, j] = sim[j, i] = scorer.generic(values[i], values[j])
+    w = np.asarray(ws)
+    weighted_rows = np.zeros(n)
+    for i in range(n):
+        others = np.arange(n) != i
+        denom = w[others].sum()
+        weighted_rows[i] = (sim[i, others] * w[others]).sum() / denom if denom else 0.0
+    best_idx = int(np.argmax(weighted_rows))
+    return values[best_idx], round(parent_valid_frac * float(weighted_rows[best_idx]), 5)
+
+
 def consensus_as_primitive(
     values: list[Any],
     consensus_settings: ConsensusSettings,
     scorer: SimilarityScorer,
     parent_valid_frac: float = 1.0,
     llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    weights: Optional[List[float]] = None,
 ) -> Tuple[Any, float]:
     non_none_values = [v for v in values if v is not None]
     if len(non_none_values) == 0:
@@ -44,6 +91,29 @@ def consensus_as_primitive(
         return (non_none_values[0], parent_valid_frac * (len(non_none_values) / len(values)))
 
     first_val_type = type(non_none_values[0])
+
+    # Strictly-additional likelihood-weighted mode: weighted clustering/medoid.
+    # The weights-None path below stays bit-identical to the reference.
+    if weights is not None:
+        total_weight = sum(weights) or 1.0
+        pairs = [
+            (float(v), w)
+            for v, w in zip(values, weights)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) and math.isfinite(float(v))
+        ]
+        if pairs and (
+            isinstance(first_val_type(), (int, float))
+            or all(isinstance(v, (int, float)) for v in non_none_values)
+        ):
+            return _weighted_numeric_consensus(
+                [x for x, _ in pairs], [w for _, w in pairs], total_weight, consensus_settings
+            )
+        nn = [(v, w) for v, w in zip(values, weights) if v is not None]
+        if len(nn) >= 2:
+            return _weighted_medoid(
+                [v for v, _ in nn], [w for _, w in nn], scorer, parent_valid_frac
+            )
+        # fall through to the unweighted path for degenerate cases
 
     # (a) llm-consensus string mode — only with embeddings similarity (:1090).
     if (

@@ -167,6 +167,7 @@ def consensus_dict(
     scorer: SimilarityScorer,
     parent_valid_frac: float = 1.0,
     llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    weights: Optional[List[float]] = None,
 ) -> Tuple[dict, Dict[str, Any]]:
     """Field-by-field consensus. Returns (merged_dict, per-field confidences)."""
     seen: set = set()
@@ -186,6 +187,7 @@ def consensus_dict(
             scorer,
             parent_valid_frac=parent_valid_frac,
             llm_consensus_fn=llm_consensus_fn,
+            weights=weights,
         )
         result[key] = val
         confs[key] = conf
@@ -199,6 +201,7 @@ def consensus_list(
     scorer: SimilarityScorer,
     parent_valid_frac: float = 1.0,
     llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    weights: Optional[List[float]] = None,
 ) -> Tuple[List[Any], List[Any]]:
     """Element-wise consensus across aligned lists (position i votes with position i)."""
     if not list_values:
@@ -223,6 +226,7 @@ def consensus_list(
             scorer,
             parent_valid_frac=parent_valid_frac,
             llm_consensus_fn=llm_consensus_fn,
+            weights=weights,
         )
         final_list.append(val_i)
         confidences.append(conf_i)
@@ -236,6 +240,7 @@ def consensus_values(
     scorer: SimilarityScorer,
     parent_valid_frac: float = 1.0,
     llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    weights: Optional[List[float]] = None,
 ) -> Tuple[Any, Union[float, List[Any], Dict[str, Any]]]:
     """Type-directed consensus dispatcher. Returns (value, confidence-structure)."""
     if not values:
@@ -250,10 +255,15 @@ def consensus_values(
         values_as_strings = [str(v).strip() for v in non_none_values]
         is_enum_like = all(len(v.split()) < 3 for v in values_as_strings)
         if is_enum_like:
-            return voting_consensus(values, consensus_settings, parent_valid_frac=parent_valid_frac)
+            return voting_consensus(
+                values, consensus_settings, parent_valid_frac=parent_valid_frac, weights=weights
+            )
 
     if isinstance(non_none_values[0], dict):
         dicts_only = [v for v in values if isinstance(v, dict)]
+        dict_weights = (
+            [w for v, w in zip(values, weights) if isinstance(v, dict)] if weights else None
+        )
         parent_valid_frac *= len(dicts_only) / len(values)
         return consensus_dict(
             dicts_only,
@@ -261,10 +271,14 @@ def consensus_values(
             scorer,
             parent_valid_frac=parent_valid_frac,
             llm_consensus_fn=llm_consensus_fn,
+            weights=dict_weights,
         )
 
     if isinstance(non_none_values[0], list):
         lists_only = [v for v in values if isinstance(v, list)]
+        list_weights = (
+            [w for v, w in zip(values, weights) if isinstance(v, list)] if weights else None
+        )
         parent_valid_frac *= len(lists_only) / len(values)
         return consensus_list(
             lists_only,
@@ -272,13 +286,18 @@ def consensus_values(
             scorer,
             parent_valid_frac=parent_valid_frac,
             llm_consensus_fn=llm_consensus_fn,
+            weights=list_weights,
         )
 
     parent_valid_frac *= len(non_none_values) / len(values)
+    nn_weights = (
+        [w for v, w in zip(values, weights) if v is not None] if weights else None
+    )
     return consensus_as_primitive(
         non_none_values,
         consensus_settings,
         scorer,
         parent_valid_frac=parent_valid_frac,
         llm_consensus_fn=llm_consensus_fn,
+        weights=nn_weights,
     )

@@ -36,6 +36,10 @@ class ConsensusSettings(BaseModel):
     # Structural aligner: "similarity" (default pipeline) or "key" (the latent
     # key-based aligner — the reference's swap point at `consolidation.py:22`).
     aligner: AlignerMethod = "similarity"
+    # Strictly-additional mode (BASELINE.json config 3): weight each sample's
+    # vote by softmax of its sequence log-likelihood (captured on-device by the
+    # local engine). False = reference-exact agreement scoring.
+    likelihood_weighting: bool = False
     # String-specific settings
     string_similarity_method: StringSimilarityMethod = "embeddings"
     string_consensus_method: StringConsensusMethod = "centroid"

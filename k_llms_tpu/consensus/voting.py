@@ -22,11 +22,22 @@ def voting_consensus(
     values: list[Union[str, bool, None]],
     consensus_settings: ConsensusSettings,
     parent_valid_frac: float = 1.0,
+    weights: Optional[list[float]] = None,
 ) -> Tuple[Optional[Union[str, bool]], float]:
+    """``weights`` (strictly-additional extension): per-sample vote weights —
+    the likelihood-weighted mode derives them from sequence logprobs. With
+    weights None every sample votes 1.0, bit-identical to the reference."""
     total_values = len(values)
 
     if not any(v is not None for v in values):
         return (None, parent_valid_frac)
+
+    if weights is None:
+        w = [1.0] * total_values
+        total_weight = float(total_values)
+    else:
+        w = list(weights)
+        total_weight = sum(w) or 1.0
 
     first_non_none = next((v for v in values if v is not None), None)
     is_boolean = isinstance(first_non_none, bool)
@@ -34,18 +45,24 @@ def voting_consensus(
     if is_boolean:
         # For booleans: treat None as False.
         processed_values = [v or False for v in values]
-        counts = Counter(processed_values)
-        best_val, best_count = counts.most_common(1)[0]
+        tallies: Counter = Counter()
+        for v, wi in zip(processed_values, w):
+            tallies[v] += wi
+        best_val, best_count = tallies.most_common(1)[0]
     else:
         if consensus_settings.allow_none_as_candidate:
             valid_values = values
+            valid_weights = w
         else:
             valid_values = [v for v in values if v is not None]
+            valid_weights = [wi for v, wi in zip(values, w) if v is not None]
         processed_values = [(sanitize_value(v) if v is not None else None) for v in valid_values]
-        counts = Counter(processed_values)
-        best_normalized, best_count = counts.most_common(1)[0]
+        tallies = Counter()
+        for v, wi in zip(processed_values, valid_weights):
+            tallies[v] += wi
+        best_normalized, best_count = tallies.most_common(1)[0]
         # Report the winner in its original (first-seen) spelling.
         best_val = valid_values[processed_values.index(best_normalized)]
 
-    confidence = parent_valid_frac * (best_count / total_values)
+    confidence = parent_valid_frac * (best_count / total_weight)
     return (best_val, round(confidence, 5))

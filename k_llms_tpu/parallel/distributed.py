@@ -1,0 +1,60 @@
+"""Multi-host initialization (DCN process groups).
+
+The reference's only transport is HTTPS to OpenAI (SURVEY.md §2.3). Here
+multi-host scale-out uses JAX's distributed runtime: every host calls
+``initialize_multihost`` before first device use; XLA then lays intra-slice
+collectives on ICI and inter-host traffic on DCN automatically. No NCCL/MPI
+analog is needed — the collectives in the sharded programs are the comms layer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from args or environment.
+
+    Environment (matching JAX conventions / TPU pod metadata):
+      KLLMS_COORDINATOR (host:port), KLLMS_NUM_PROCESSES, KLLMS_PROCESS_ID —
+    falls back to jax.distributed's own auto-detection on TPU pods. Returns
+    True if distributed mode was initialized, False for single-host runs.
+    """
+    coordinator_address = coordinator_address or os.getenv("KLLMS_COORDINATOR")
+    num_processes = num_processes or _int_env("KLLMS_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env("KLLMS_PROCESS_ID")
+
+    if coordinator_address is None and num_processes is None:
+        return False  # single host
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %s/%s",
+        jax.process_index(),
+        jax.process_count(),
+    )
+    return True
+
+
+def _int_env(name: str) -> Optional[int]:
+    val = os.getenv(name)
+    return int(val) if val else None
+
+
+def global_mesh_devices():
+    """All devices across processes (for building multi-host meshes)."""
+    return jax.devices()

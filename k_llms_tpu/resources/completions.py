@@ -23,6 +23,22 @@ from ..consensus.consolidation import (
 from ..consensus.settings import ConsensusSettings
 from ..consensus.similarity import SimilarityScorer
 from ..types import KLLMsChatCompletion, KLLMsParsedChatCompletion
+from ..utils.observability import Trace
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def _attach_trace(result, trace: Trace):
+    """Phase timings: logged at DEBUG always; attached to the response as a
+    ``timings`` extension only when KLLMS_TRACE=1 (keeps the default wire
+    payload byte-identical to the reference contract)."""
+    logger.debug("request timings: %s", trace.as_dict())
+    if os.getenv("KLLMS_TRACE") == "1":
+        result.timings = trace.as_dict()
+    return result
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..client import AsyncKLLMs, KLLMs
@@ -94,13 +110,17 @@ class Completions:
             messages, model or self._wrapper.default_model, n, temperature, max_tokens,
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
         )
-        completion = self._wrapper.backend.chat_completion(request)
-        return consolidate_chat_completions(
-            completion,
-            self._scorer(settings),
-            consensus_settings=settings,
-            llm_consensus_fn=self._wrapper.backend.llm_consensus,
-        )
+        trace = Trace()
+        with trace.phase("sample"):
+            completion = self._wrapper.backend.chat_completion(request)
+        with trace.phase("consolidate"):
+            result = consolidate_chat_completions(
+                completion,
+                self._scorer(settings),
+                consensus_settings=settings,
+                llm_consensus_fn=self._wrapper.backend.llm_consensus,
+            )
+        return _attach_trace(result, trace)
 
     def parse(
         self,
@@ -124,14 +144,18 @@ class Completions:
             messages, model or self._wrapper.default_model, n, temperature, max_tokens,
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
         )
-        completion = self._wrapper.backend.chat_completion(request)
-        return consolidate_parsed_chat_completions(
-            completion,
-            self._scorer(settings),
-            consensus_settings=settings,
-            response_format=response_format,
-            llm_consensus_fn=self._wrapper.backend.llm_consensus,
-        )
+        trace = Trace()
+        with trace.phase("sample"):
+            completion = self._wrapper.backend.chat_completion(request)
+        with trace.phase("consolidate"):
+            result = consolidate_parsed_chat_completions(
+                completion,
+                self._scorer(settings),
+                consensus_settings=settings,
+                response_format=response_format,
+                llm_consensus_fn=self._wrapper.backend.llm_consensus,
+            )
+        return _attach_trace(result, trace)
 
 
 class AsyncCompletions:

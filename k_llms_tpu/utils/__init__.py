@@ -1,0 +1,8 @@
+from .observability import (
+    Trace,
+    confidence_histogram,
+    configure_logging,
+    device_profiler,
+)
+
+__all__ = ["Trace", "confidence_histogram", "configure_logging", "device_profiler"]

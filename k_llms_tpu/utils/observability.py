@@ -1,0 +1,89 @@
+"""Tracing, metrics, and logging.
+
+The reference has no tracing/profiling (SURVEY.md §5) — only a DEBUG logger
+gated on ``ENV_NAME=dev`` (`consensus_utils.py:45-50`), which we keep. Added
+here: per-phase wall timers for the request pipeline (sample / align+consensus
+run host-side; decode runs on device), a ``jax.profiler`` wrapper for device
+traces (Perfetto-compatible dumps), and consensus-confidence histograms.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+def configure_logging() -> logging.Logger:
+    """Package logger; DEBUG iff ENV_NAME=dev (reference parity)."""
+    logger = logging.getLogger("k_llms_tpu")
+    if os.getenv("ENV_NAME") == "dev":
+        logger.setLevel(logging.DEBUG)
+    else:
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+class Trace:
+    """Wall-clock phase timers for one request: trace.phase("sample") blocks."""
+
+    def __init__(self) -> None:
+        self.durations: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.durations[name] = self.durations.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def as_dict(self) -> Dict[str, float]:
+        return {k: round(v, 6) for k, v in self.durations.items()}
+
+
+@contextlib.contextmanager
+def device_profiler(log_dir: Optional[str] = None) -> Iterator[None]:
+    """jax.profiler trace around a block (view with TensorBoard/Perfetto).
+    No-ops when log_dir is None and KLLMS_PROFILE_DIR is unset."""
+    import jax
+
+    log_dir = log_dir or os.getenv("KLLMS_PROFILE_DIR")
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def _walk_confidences(node: Any, out: List[float]) -> None:
+    if isinstance(node, dict):
+        for v in node.values():
+            _walk_confidences(v, out)
+    elif isinstance(node, (list, tuple)):
+        for v in node:
+            _walk_confidences(v, out)
+    elif isinstance(node, (int, float)) and not isinstance(node, bool):
+        out.append(float(node))
+
+
+def confidence_histogram(likelihoods: Any, bins: int = 10) -> Dict[str, Any]:
+    """Histogram + summary stats over every confidence in a likelihoods tree."""
+    values: List[float] = []
+    _walk_confidences(likelihoods, values)
+    if not values:
+        return {"count": 0, "histogram": [0] * bins, "mean": None, "min": None}
+    counts = [0] * bins
+    for v in values:
+        idx = min(int(max(0.0, min(1.0, v)) * bins), bins - 1)
+        counts[idx] += 1
+    return {
+        "count": len(values),
+        "histogram": counts,
+        "mean": round(sum(values) / len(values), 5),
+        "min": round(min(values), 5),
+    }

@@ -1,0 +1,70 @@
+"""Observability + config + multihost-init plumbing."""
+
+import os
+
+import pytest
+
+from k_llms_tpu import KLLMs
+from k_llms_tpu.backends.tpu import BackendConfig, TpuBackend
+from k_llms_tpu.parallel.distributed import initialize_multihost
+from k_llms_tpu.utils.observability import Trace, confidence_histogram
+
+
+def test_trace_phases():
+    t = Trace()
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        with t.phase("a"):
+            pass
+    d = t.as_dict()
+    assert set(d) == {"a", "b"}
+    assert d["a"] >= 0
+
+
+def test_confidence_histogram():
+    lik = {"a": 0.9, "b": [0.1, 0.5], "c": {"d": 1.0, "reason": True}}
+    h = confidence_histogram(lik)
+    assert h["count"] == 4  # bool excluded
+    assert sum(h["histogram"]) == 4
+    assert h["min"] == 0.1
+    empty = confidence_histogram({})
+    assert empty["count"] == 0
+
+
+def test_timings_attached_when_traced(monkeypatch):
+    monkeypatch.setenv("KLLMS_TRACE", "1")
+    client = KLLMs(backend="fake", responses=[["a", "a"]])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=2
+    )
+    assert resp.timings["sample"] >= 0
+    assert "consolidate" in resp.timings
+
+
+def test_timings_absent_by_default(monkeypatch):
+    monkeypatch.delenv("KLLMS_TRACE", raising=False)
+    client = KLLMs(backend="fake", responses=[["a", "a"]])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=2
+    )
+    assert getattr(resp, "timings", None) is None
+
+
+def test_backend_config_overrides():
+    backend = TpuBackend(
+        config=BackendConfig(model="tiny", dtype="float32", max_new_tokens=4, attention_impl="xla")
+    )
+    assert backend.engine.config.dtype == "float32"
+    assert backend.default_max_new_tokens == 4
+
+
+def test_backend_kwargs_still_work():
+    backend = TpuBackend(model="tiny", max_new_tokens=8)
+    assert backend.backend_config.max_new_tokens == 8
+
+
+def test_initialize_multihost_noop_single_host(monkeypatch):
+    monkeypatch.delenv("KLLMS_COORDINATOR", raising=False)
+    monkeypatch.delenv("KLLMS_NUM_PROCESSES", raising=False)
+    assert initialize_multihost() is False
