@@ -59,6 +59,21 @@ def _format_consensus_content(consensus_content: Optional[Dict[str, Any]]) -> st
     return json.dumps(consensus_content)
 
 
+def _collect_strings(node: Any, out: Optional[List[str]] = None) -> List[str]:
+    """All string values in a nested structure (for embedding prefetch)."""
+    if out is None:
+        out = []
+    if isinstance(node, str):
+        out.append(node)
+    elif isinstance(node, dict):
+        for v in node.values():
+            _collect_strings(v, out)
+    elif isinstance(node, (list, tuple)):
+        for v in node:
+            _collect_strings(v, out)
+    return out
+
+
 def _sample_weights(choices, contents_mask: List[bool]) -> Optional[List[float]]:
     """Softmax of per-sample sequence logprobs (the engine attaches
     ``sample_logprob`` to each choice); None when any sample lacks one."""
@@ -89,6 +104,7 @@ def _consensus_over_contents(
 ):
     """Shared align-then-vote step over parsed choice contents."""
     if len(contents) >= 2:
+        scorer.prefetch_embeddings(_collect_strings(contents))
         if consensus_settings.aligner == "key":
             # Swap point (reference `consolidation.py:22`): key-based aligner
             # behind the same signature.

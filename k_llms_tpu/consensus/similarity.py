@@ -90,6 +90,30 @@ class SimilarityScorer:
         self._emb_cache = TTLCache(maxsize=cache_maxsize, ttl=cache_ttl)
 
     # -- embeddings -------------------------------------------------------
+    def prefetch_embeddings(self, texts: List[str]) -> None:
+        """Batch-embed the long strings that similarity will need and warm the
+        cache — turns the engine's lazy per-pair, batch-1 device calls into ONE
+        batched forward (big win for n=32 consensus latency)."""
+        if self.embed_fn is None or self.method != "embeddings":
+            return
+        missing, seen = [], set()
+        for t in texts:
+            if (
+                isinstance(t, str)
+                and len(t) > EMBEDDING_MIN_CHARS
+                and t not in seen
+                and self._emb_cache.get(t) is None
+            ):
+                missing.append(t)
+                seen.add(t)
+        if not missing:
+            return
+        try:
+            for t, e in zip(missing, self.embed_fn(missing)):
+                self._emb_cache.set(t, e)
+        except Exception as e:  # lazy path will retry / degrade per pair
+            logger.error("embedding prefetch failed", exc_info=e)
+
     def get_embedding(self, s: str) -> List[float]:
         cached = self._emb_cache.get(s)
         if cached is not None:
