@@ -81,6 +81,11 @@ class TpuBackend(Backend):
             param_seed=cfg.param_seed,
         )
         self.default_max_new_tokens = cfg.max_new_tokens
+        # All device work funnels through one scheduler so concurrent clients
+        # (AsyncKLLMs, threads) serialize cleanly instead of racing jit caches.
+        from ..engine.scheduler import EngineScheduler
+
+        self.scheduler = EngineScheduler(name=self.model_name)
 
     # -- chat -------------------------------------------------------------
     def chat_completion(self, request: ChatRequest) -> ChatCompletion:
@@ -90,14 +95,16 @@ class TpuBackend(Backend):
 
         temperature = 1.0 if request.temperature is None else float(request.temperature)
         max_new = request.max_tokens or self.default_max_new_tokens
-        result = self.engine.generate(
-            prompt_ids,
-            n=n,
-            max_new_tokens=max_new,
-            temperature=temperature,
-            top_p=request.top_p,
-            seed=request.seed,
-            eos_ids=tok.stop_ids,
+        result = self.scheduler.call(
+            lambda: self.engine.generate(
+                prompt_ids,
+                n=n,
+                max_new_tokens=max_new,
+                temperature=temperature,
+                top_p=request.top_p,
+                seed=request.seed,
+                eos_ids=tok.stop_ids,
+            )
         )
 
         stop_strings: List[str] = []
@@ -168,7 +175,7 @@ class TpuBackend(Backend):
         token_lists = [
             self.tokenizer.encode(t)[:MAX_EMBEDDING_TOKENS] for t in texts
         ]
-        pooled = self.engine.embed_tokens(token_lists)
+        pooled = self.scheduler.call(lambda: self.engine.embed_tokens(token_lists))
         return [[float(x) for x in row] for row in pooled]
 
     # -- llm-consensus ----------------------------------------------------
@@ -181,12 +188,14 @@ class TpuBackend(Backend):
             {"role": "user", "content": f"Input: {[json.dumps(v) for v in values]}\nOutput:"},
         ]
         ids = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
-        result = self.engine.generate(
-            ids,
-            n=1,
-            max_new_tokens=128,
-            temperature=0.0,
-            eos_ids=self.tokenizer.stop_ids,
+        result = self.scheduler.call(
+            lambda: self.engine.generate(
+                ids,
+                n=1,
+                max_new_tokens=128,
+                temperature=0.0,
+                eos_ids=self.tokenizer.stop_ids,
+            )
         )
         text = self.tokenizer.decode(
             [int(t) for t in result.tokens[0][: int(result.lengths[0])]]

@@ -1,0 +1,83 @@
+"""Sequence-parallel (context-parallel) forward pass for long prompts.
+
+The reference delegates sequence length to the provider (SURVEY.md §5); here
+long context is first-class: activations shard over the mesh's sequence axis,
+every position-wise op (norms, projections, MLP) runs locally on its shard, and
+attention is the exact ring algorithm from ``ops/ring_attention.py`` — K/V
+chunks rotate over ICI with online-softmax accumulation, so per-device memory
+is O(S/P) and context scales with the ring size.
+
+Used for prefilling prompts too long for one device's HBM; the resulting KV
+cache is already sequence-sharded for subsequent ring decode, or can be
+gathered for the dense shared-prefix decode path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.llama import rms_norm, rope_embed
+from ..ops.ring_attention import ring_attention
+
+
+def forward_sequence_parallel(
+    config: ModelConfig,
+    params,
+    tokens: jax.Array,
+    mesh: Mesh,
+    seq_axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Full causal forward with the sequence sharded over ``seq_axis``.
+
+    tokens: [B, S] with S divisible by the ring size. Returns (logits f32
+    [B, S, V], final hidden [B, S, H]), both sequence-sharded.
+    """
+    B, S = tokens.shape
+    ring = mesh.shape[seq_axis]
+    if S % ring != 0:
+        raise ValueError(f"sequence length {S} must divide by ring size {ring}")
+
+    seq_sharded = NamedSharding(mesh, P(None, seq_axis, None))
+
+    def constrain(x):
+        return lax.with_sharding_constraint(x, seq_sharded)
+
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    x = constrain(jnp.take(params["embed"], tokens, axis=0))
+
+    def body(x, layer):
+        h = rms_norm(x, layer["attn_norm"], config.rms_eps)
+        q = (h @ layer["wq"]).reshape(B, S, config.num_heads, config.head_dim)
+        k = (h @ layer["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+        v = (h @ layer["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+        q = rope_embed(q, positions, config.rope_theta)
+        k = rope_embed(k, positions, config.rope_theta)
+
+        attn = ring_attention(
+            mesh,
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            seq_axis=seq_axis,
+            causal=True,
+        ).transpose(0, 2, 1, 3)
+        attn = attn.astype(x.dtype).reshape(B, S, config.q_dim)
+        x = constrain(x + attn @ layer["wo"])
+
+        h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+        gate = jax.nn.silu(h @ layer["w_gate"])
+        up = h @ layer["w_up"]
+        x = constrain(x + (gate * up) @ layer["w_down"])
+        return x, None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    h = rms_norm(x, params["final_norm"], config.rms_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    return logits, h

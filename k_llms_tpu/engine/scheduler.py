@@ -1,0 +1,77 @@
+"""Request scheduler: a queue in front of the device mesh.
+
+The reference's async client just multiplexes HTTP (SURVEY.md §3.3); a local
+engine owns actual hardware, so concurrent callers need ordering: one worker
+thread drains a FIFO queue and runs device work serially (the chip is serial
+anyway — interleaving jit dispatches from many threads only causes duplicate
+compiles and contention). Callers get ``concurrent.futures.Future``s;
+``AsyncKLLMs`` awaits them without blocking the event loop. Queue depth and
+service counts are exposed for observability.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class EngineScheduler:
+    """Serializes closures onto one worker thread; thread-safe submit."""
+
+    def __init__(self, name: str = "engine"):
+        self._queue: "queue.Queue[Optional[tuple[Future, Callable[[], Any]]]]" = queue.Queue()
+        self._served = 0
+        self._errors = 0
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._run, name=f"kllms-{name}-worker", daemon=True
+        )
+        self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            future, fn = item
+            if not future.set_running_or_notify_cancel():
+                continue
+            try:
+                future.set_result(fn())
+                with self._lock:
+                    self._served += 1
+            except BaseException as e:  # deliver to the caller, keep serving
+                with self._lock:
+                    self._errors += 1
+                future.set_exception(e)
+
+    def submit(self, fn: Callable[[], Any]) -> Future:
+        future: Future = Future()
+        self._queue.put((future, fn))
+        return future
+
+    def call(self, fn: Callable[[], Any]) -> Any:
+        """Synchronous convenience: submit and wait. Re-entrant from the
+        worker thread itself (runs inline — prevents self-deadlock when device
+        work triggers more device work, e.g. llm-consensus inside a request)."""
+        if threading.current_thread() is self._worker:
+            return fn()
+        return self.submit(fn).result()
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "queued": self._queue.qsize(),
+                "served": self._served,
+                "errors": self._errors,
+            }
+
+    def shutdown(self) -> None:
+        self._queue.put(None)
+        self._worker.join(timeout=5)

@@ -30,6 +30,13 @@ def sample_logits(
     untempered model distribution).
     """
     B, V = logits.shape
+    # Failure tolerance: a sample whose logits went non-finite (overflow in a
+    # bad checkpoint, etc.) must not poison the batch — sanitize to a uniform
+    # distribution for that row; the consensus layer then simply outvotes it.
+    finite = jnp.isfinite(logits)
+    row_ok = jnp.any(finite, axis=-1, keepdims=True)
+    logits = jnp.where(finite, logits, -jnp.inf)
+    logits = jnp.where(row_ok, logits, 0.0)
     model_logprobs = jax.nn.log_softmax(logits, axis=-1)
 
     if temperature == 0.0:

@@ -1,0 +1,81 @@
+"""Graceful-degradation ladder (SURVEY.md §5): failed samples drop out of the
+vote instead of failing the request; parse failures degrade to None; support
+thresholds relax rather than explode."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from k_llms_tpu import KLLMs
+from k_llms_tpu.consensus.consolidation import _safe_parse_content
+from k_llms_tpu.consensus.similarity import SimilarityScorer
+from k_llms_tpu.consensus.consolidation import consolidate_chat_completions
+from k_llms_tpu.ops.sampling import sample_logits
+from k_llms_tpu.types import ChatCompletion
+
+
+def _completion(contents):
+    return ChatCompletion.model_validate(
+        {
+            "id": "c",
+            "created": 0,
+            "model": "m",
+            "object": "chat.completion",
+            "choices": [
+                {
+                    "finish_reason": "stop",
+                    "index": i,
+                    "message": {"role": "assistant", "content": content},
+                }
+                for i, content in enumerate(contents)
+            ],
+        }
+    )
+
+
+def test_empty_sample_drops_out_of_vote():
+    # sample 3 produced nothing -> it is excluded from consensus but still
+    # listed among the original choices
+    comp = _completion(["yes", "yes", ""])
+    result = consolidate_chat_completions(comp, SimilarityScorer.levenshtein())
+    assert result.choices[0].message.content == "yes"
+    assert result.likelihoods == {"text": 1.0}  # 2/2 of the valid samples
+    assert len(result.choices) == 4
+
+
+def test_malformed_json_degrades_to_text_wrap():
+    assert _safe_parse_content("{broken json") == {"text": "{broken json"}
+    assert _safe_parse_content(None) == {"text": None}
+
+
+def test_mixed_json_and_garbage_still_consolidates():
+    comp = _completion(['{"a": 1}', '{"a": 1}', "total garbage"])
+    result = consolidate_chat_completions(comp, SimilarityScorer.levenshtein())
+    # structures disagree ({"a":...} vs {"text":...}) but the request succeeds
+    assert result.choices[0].message.content is not None
+    assert result.likelihoods is not None
+
+
+def test_nonfinite_logits_sanitized():
+    logits = jnp.array(
+        [[1.0, 2.0, 3.0, 4.0], [jnp.nan, jnp.nan, jnp.nan, jnp.nan], [1.0, jnp.inf, 0.0, 0.0]],
+        jnp.float32,
+    )
+    import jax
+
+    toks, lps = sample_logits(logits, jax.random.key(0), temperature=1.0)
+    assert toks.shape == (3,)
+    assert np.isfinite(np.asarray(lps)).all()
+    # greedy on the row with a single +inf picks it deterministically... +inf is
+    # masked to -inf, so argmax falls to the best finite value
+    toks0, _ = sample_logits(logits, jax.random.key(0), temperature=0.0)
+    assert int(toks0[0]) == 3
+    assert int(toks0[2]) == 0
+
+
+def test_single_sample_failure_does_not_fail_request():
+    # a responder that errors for one sample: model empty text for it
+    client = KLLMs(backend="fake", responses=[["ok answer", "", "ok answer"]])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3
+    )
+    assert resp.choices[0].message.content == "ok answer"

@@ -1,0 +1,41 @@
+"""Sequence-parallel forward must match the dense forward exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k_llms_tpu.engine.long_context import forward_sequence_parallel
+from k_llms_tpu.models import get_config, init_params
+from k_llms_tpu.models.llama import forward
+from k_llms_tpu.parallel.mesh import make_mesh
+
+
+def test_sequence_parallel_matches_dense():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(8, 1)
+    B, S = 2, 64
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
+
+    logits_sp, hidden_sp = jax.jit(
+        lambda p, t: forward_sequence_parallel(cfg, p, t, mesh, seq_axis="data")
+    )(params, tokens)
+    logits_ref, hidden_ref = forward(cfg, params, tokens, jnp.ones((B, S), jnp.int32))
+
+    np.testing.assert_allclose(
+        np.asarray(logits_sp), np.asarray(logits_ref), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(hidden_sp), np.asarray(hidden_ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_sequence_parallel_rejects_indivisible():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(8, 1)
+    tokens = jnp.zeros((1, 60), jnp.int32)
+    import pytest
+
+    with pytest.raises(ValueError):
+        forward_sequence_parallel(cfg, params, tokens, mesh)

@@ -1,0 +1,72 @@
+"""Request scheduler: serial device access, concurrent async clients."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from k_llms_tpu import AsyncKLLMs
+from k_llms_tpu.engine.scheduler import EngineScheduler
+
+
+def test_scheduler_serializes():
+    sched = EngineScheduler(name="t")
+    active = []
+    overlap = []
+
+    def work(i):
+        active.append(i)
+        if len(active) > 1:
+            overlap.append(tuple(active))
+        time.sleep(0.01)
+        active.remove(i)
+        return i
+
+    futures = [sched.submit(lambda i=i: work(i)) for i in range(8)]
+    results = [f.result() for f in futures]
+    assert results == list(range(8))
+    assert overlap == []  # never two jobs at once
+    assert sched.stats["served"] == 8
+    sched.shutdown()
+
+
+def test_scheduler_exception_propagates():
+    sched = EngineScheduler(name="t2")
+
+    def boom():
+        raise RuntimeError("device on fire")
+
+    with pytest.raises(RuntimeError, match="device on fire"):
+        sched.submit(boom).result()
+    # still serves after an error
+    assert sched.call(lambda: 42) == 42
+    assert sched.stats["errors"] == 1
+    sched.shutdown()
+
+
+def test_scheduler_reentrant_from_worker():
+    sched = EngineScheduler(name="t3")
+
+    def outer():
+        return sched.call(lambda: "inner")  # would deadlock without reentrancy
+
+    assert sched.call(outer) == "inner"
+    sched.shutdown()
+
+
+def test_concurrent_async_clients_share_engine():
+    async def main():
+        client = AsyncKLLMs(backend="tpu", model="tiny", max_new_tokens=6)
+        reqs = [
+            client.chat.completions.create(
+                messages=[{"role": "user", "content": f"q{i}"}], model="tiny", n=2, seed=i
+            )
+            for i in range(4)
+        ]
+        return await asyncio.gather(*reqs)
+
+    results = asyncio.run(main())
+    assert len(results) == 4
+    for r in results:
+        assert len(r.choices) == 3
