@@ -238,9 +238,13 @@ class LocalEngine:
             self.params, prefix, jnp.int32(prompt_len), first_logits, key, eos_arr
         )
 
-        toks_np = np.asarray(jax.device_get(toks))[:n]
-        lps_np = np.asarray(jax.device_get(lps))[:n]
-        done_np = np.asarray(jax.device_get(done))[:n]
+        # ONE host transfer for all outputs: on relayed/remote device platforms
+        # every device_get pays a full round trip (~74 ms through the axon
+        # relay), so fetching the three buffers separately would triple it.
+        toks_np, lps_np, done_np = jax.device_get((toks, lps, done))
+        toks_np = np.asarray(toks_np)[:n]
+        lps_np = np.asarray(lps_np)[:n]
+        done_np = np.asarray(done_np)[:n]
 
         lengths = (toks_np != config.pad_token_id).sum(axis=1).astype(np.int32)
         # A sample that emitted pad_id as a real token would undercount; the

@@ -70,6 +70,7 @@ def get_config(name: str) -> ModelConfig:
 register_config(
     ModelConfig(
         name="llama-3-8b",
+        attention_impl="flash",
         vocab_size=128256,
         hidden_size=4096,
         intermediate_size=14336,
@@ -85,6 +86,7 @@ register_config(
 register_config(
     ModelConfig(
         name="llama-3.2-1b",
+        attention_impl="flash",
         vocab_size=128256,
         hidden_size=2048,
         intermediate_size=8192,
@@ -102,6 +104,7 @@ register_config(
 register_config(
     ModelConfig(
         name="llama-1b-byte",
+        attention_impl="flash",
         vocab_size=512,
         hidden_size=2048,
         intermediate_size=8192,
