@@ -40,6 +40,27 @@ class Backend(abc.ABC):
     def embeddings(self, texts: List[str]) -> List[List[float]]:
         """Similarity-side-channel embeddings (reference `client.py:75-122`)."""
 
+    #: Model name the plain ``embeddings()`` entry point uses; the client maps a
+    #: requested model of "local" to this so pricing follows the model actually hit.
+    embedding_model_name: str = "local"
+
+    def embeddings_with_usage(
+        self, texts: List[str], model: Optional[str] = None
+    ) -> "tuple[List[List[float]], int]":
+        """Embeddings plus billed prompt-token count for the batch (the reference
+        accumulates `response.usage.prompt_tokens` per batch, `client.py:116`).
+        ``model`` selects the embedding model on backends that have several;
+        local backends have one and bill nothing."""
+        return self.embeddings(texts), 0
+
+    def crop_texts(
+        self, texts: List[str], max_tokens: int, model: Optional[str] = None
+    ) -> List[str]:
+        """Crop each text to ``max_tokens`` in the tokenizer of ``model`` (the
+        reference crops via tiktoken before embedding, `client.py:98-102`).
+        Backends without a tokenizer pass texts through unchanged."""
+        return list(texts)
+
     def llm_consensus(self, values: List[str]) -> str:
         """Build a consensus string from candidates (reference
         `consensus_utils.py:1026-1048` hardcodes gpt-5-mini; local backends answer

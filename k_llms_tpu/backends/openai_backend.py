@@ -41,6 +41,7 @@ class OpenAIBackend(Backend):
             **kwargs,
         )
         self._embedding_model = embedding_model
+        self.embedding_model_name = embedding_model
 
     @property
     def client(self):
@@ -70,6 +71,26 @@ class OpenAIBackend(Backend):
     def embeddings(self, texts: List[str]) -> List[List[float]]:
         response = self._client.embeddings.create(input=texts, model=self._embedding_model)
         return [item.embedding for item in response.data]
+
+    def embeddings_with_usage(self, texts: List[str], model: Optional[str] = None):
+        effective = model if model and model != "local" else self._embedding_model
+        response = self._client.embeddings.create(input=texts, model=effective)
+        tokens = response.usage.prompt_tokens if response.usage else 0
+        return [item.embedding for item in response.data], tokens
+
+    def crop_texts(
+        self, texts: List[str], max_tokens: int, model: Optional[str] = None
+    ) -> List[str]:
+        effective = model if model and model != "local" else self._embedding_model
+        try:
+            import tiktoken  # type: ignore
+        except ImportError:  # pragma: no cover
+            # Conservative fallback: one token is at least one character, so a
+            # char-level crop can never exceed the cap (an uncropped send would
+            # make the client's crop-all retry a guaranteed second failure).
+            return [t[:max_tokens] for t in texts]
+        enc = tiktoken.encoding_for_model(effective)
+        return [enc.decode(enc.encode(t)[:max_tokens]) for t in texts]
 
     def llm_consensus(self, values: List[str]) -> str:
         import json

@@ -178,6 +178,12 @@ class TpuBackend(Backend):
         pooled = self.scheduler.call(lambda: self.engine.embed_tokens(token_lists))
         return [[float(x) for x in row] for row in pooled]
 
+    def crop_texts(
+        self, texts: List[str], max_tokens: int, model: Optional[str] = None
+    ) -> List[str]:
+        tok = self.tokenizer
+        return [tok.decode(tok.encode(t)[:max_tokens]) for t in texts]
+
     # -- llm-consensus ----------------------------------------------------
     def llm_consensus(self, values: List[str]) -> str:
         assert len(values) > 0, "Cannot build consensus string from empty list"

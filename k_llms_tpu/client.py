@@ -15,9 +15,58 @@ from typing import Any, List, Optional, Union
 from .backends.base import Backend, resolve_backend
 from .resources.completions import AsyncCompletions, Completions
 
-# Embedding crop limit kept from the reference (`client.py:12`); the local
-# embedding path enforces the same cap so degradation behavior matches.
-MAX_EMBEDDING_TOKENS = 8191
+# Embedding model caps and pricing, kept bit-identical to the reference
+# (`client.py:12-13`). "local" is the on-device path: same 8191 crop cap so the
+# degradation behavior matches, zero price.
+MAX_TOKENS_PER_MODEL = {
+    "local": 8191,
+    "text-embedding-3-small": 8191,
+    "text-embedding-3-large": 8191,
+}
+PRICING = {"local": 0.0, "text-embedding-3-small": 0.020, "text-embedding-3-large": 0.13}
+MAX_EMBEDDING_TOKENS = MAX_TOKENS_PER_MODEL["local"]
+
+
+def _progress_range(stop: int, step: int, verbose: bool):
+    if verbose:
+        try:
+            import tqdm
+
+            return tqdm.trange(0, stop, step)
+        except ImportError:  # pragma: no cover
+            pass
+    return range(0, stop, step)
+
+
+def _resolve_embedding_model(backend: Backend, model: str) -> str:
+    """Map the sentinel "local" to whatever model the backend actually embeds
+    with, so crop caps and pricing follow the model that gets hit."""
+    effective = model if model != "local" else getattr(backend, "embedding_model_name", "local")
+    if effective not in MAX_TOKENS_PER_MODEL:
+        raise ValueError(
+            f"Model {effective} not supported. Available models: "
+            f"{list(MAX_TOKENS_PER_MODEL.keys())}"
+        )
+    return effective
+
+
+def _embed_batches(
+    backend: Backend,
+    processed: List[str],
+    model: str,
+    batch_size: int,
+    verbose: bool,
+    embeddings: List[List[float]],
+    price_acc: List[float],
+) -> None:
+    """Shared batching/pricing loop (reference `client.py:108-117`): extends
+    ``embeddings`` and ``price_acc[0]`` in place per batch, so a retry after a
+    partial failure keeps billing what the failed attempt already spent."""
+    for idx in _progress_range(len(processed), batch_size, verbose):
+        batch = processed[idx : idx + batch_size]
+        vectors, prompt_tokens = backend.embeddings_with_usage(batch, model=model)
+        price_acc[0] += prompt_tokens * PRICING[model] / 1000000.0
+        embeddings.extend(vectors)
 
 
 class _BaseKLLMs:
@@ -46,11 +95,20 @@ class _BaseKLLMs:
         batch_size: int = 2048,
         verbose: bool = False,
     ) -> List[List[float]]:
-        """Batched embeddings helper (reference `client.py:75-122`). Batch-size
-        chunking kept; pricing accounting is moot for a local model."""
+        """Batched embeddings helper (reference `client.py:75-122`): validate the
+        model, crop every text to the model's token cap, chunk by ``batch_size``,
+        accumulate the billed price, report progress when ``verbose``."""
+        model = _resolve_embedding_model(self._backend, model)
+        max_tokens = MAX_TOKENS_PER_MODEL[model]
+        processed = self._backend.crop_texts(texts, max_tokens, model=model)
+
         embeddings: List[List[float]] = []
-        for idx in range(0, len(texts), batch_size):
-            embeddings.extend(self._backend.embeddings(texts[idx : idx + batch_size]))
+        price_acc = [0.0]
+        _embed_batches(
+            self._backend, processed, model, batch_size, verbose, embeddings, price_acc
+        )
+        if verbose:
+            print(f"TOTAL PRICE: ${price_acc[0]:.6f}")
         return embeddings
 
 
@@ -65,10 +123,61 @@ class AsyncKLLMs(_BaseKLLMs):
         super().__init__(**kwargs)
         self.chat = AsyncChat(self)
 
-    async def aget_embeddings(self, texts: List[str], **kwargs: Any) -> List[List[float]]:
+    async def async_get_embeddings(
+        self,
+        texts: List[str],
+        model: str = "local",
+        batch_size: int = 2048,
+        verbose: bool = False,
+    ) -> List[List[float]]:
+        """Async embeddings with the reference's two-stage crop ladder
+        (`client.py:125-196`): selectively crop only texts long enough to
+        plausibly exceed the cap (cheap heuristic, off-thread), then on ANY
+        failure re-crop everything and retry once."""
         import asyncio
 
-        return await asyncio.to_thread(lambda: self.get_embeddings(texts, **kwargs))
+        model = _resolve_embedding_model(self._backend, model)
+        max_tokens = MAX_TOKENS_PER_MODEL[model]
+        backend = self._backend
+
+        def selective_crop() -> List[str]:
+            # ~3 chars/token lower bound: short strings can't exceed the cap.
+            long_idx = [i for i, t in enumerate(texts) if len(t) * 3 > max_tokens]
+            if not long_idx:
+                return list(texts)
+            cropped = backend.crop_texts([texts[i] for i in long_idx], max_tokens, model=model)
+            out = list(texts)
+            for i, c in zip(long_idx, cropped):
+                out[i] = c
+            return out
+
+        def crop_all() -> List[str]:
+            return backend.crop_texts(list(texts), max_tokens, model=model)
+
+        price_acc = [0.0]
+        embeddings: List[List[float]] = []
+
+        def run_batches(processed: List[str]) -> List[List[float]]:
+            embeddings.clear()
+            _embed_batches(
+                backend, processed, model, batch_size, verbose, embeddings, price_acc
+            )
+            return embeddings
+
+        processed = await asyncio.to_thread(selective_crop)
+        try:
+            result = await asyncio.to_thread(run_batches, processed)
+        except Exception as e:
+            if verbose:
+                print(f"Embedding request failed with error: {e}. Retrying with all strings cropped.")
+            processed = await asyncio.to_thread(crop_all)
+            result = await asyncio.to_thread(run_batches, processed)
+        if verbose:
+            print(f"TOTAL PRICE: ${price_acc[0]:.6f}")
+        return result
+
+    # Short alias kept for earlier adopters of this package.
+    aget_embeddings = async_get_embeddings
 
 
 class Chat:

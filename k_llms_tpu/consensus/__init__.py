@@ -16,13 +16,14 @@ Behavioral-parity reimplementation of the reference consensus stack
 from .settings import ConsensusSettings, SIMILARITY_SCORE_LOWER_BOUND
 from .similarity import SimilarityScorer
 from .voting import voting_consensus, sanitize_value
-from .primitive import consensus_as_primitive
+from .primitive import compute_similarity_scores, consensus_as_primitive
 from .majority import sort_by_original_majority
 from .alignment import lists_alignment
 from .recursion import (
     consensus_dict,
     consensus_list,
     consensus_values,
+    intermediary_consensus_cleanup,
     recursive_list_alignments,
 )
 from .consolidation import (
@@ -39,7 +40,9 @@ __all__ = [
     "SimilarityScorer",
     "voting_consensus",
     "sanitize_value",
+    "compute_similarity_scores",
     "consensus_as_primitive",
+    "intermediary_consensus_cleanup",
     "sort_by_original_majority",
     "lists_alignment",
     "consensus_dict",

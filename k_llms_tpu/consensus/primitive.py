@@ -277,3 +277,21 @@ def consensus_as_primitive(
     best_value = values[best_idx]
     confidence = parent_valid_frac * float(avg_sims[best_idx])
     return (best_value, round(confidence, 5))
+
+
+def compute_similarity_scores(values: list, scorer: SimilarityScorer) -> list:
+    """Per-value mean similarity against all values (self included, at 1.0) —
+    scores without electing a winner. Parity: ``compute_similarity_scores``,
+    `/root/reference/k_llms/utils/consensus_utils.py:1243-1263`."""
+    n = len(values)
+    if n == 0:
+        return []
+    if n == 1:
+        return [1.0]
+    sim_matrix = np.zeros((n, n), dtype=float)
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim = scorer.generic(values[i], values[j])
+            sim_matrix[i, j] = sim_matrix[j, i] = sim
+        sim_matrix[i, i] = 1.0
+    return [float(round(score, 5)) for score in sim_matrix.mean(axis=1)]

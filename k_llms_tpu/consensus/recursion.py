@@ -301,3 +301,21 @@ def consensus_values(
         llm_consensus_fn=llm_consensus_fn,
         weights=nn_weights,
     )
+
+
+def intermediary_consensus_cleanup(obj):
+    """Strip empty strings/dicts/lists recursively, collapsing emptied containers
+    to None. Parity: ``intermediary_consensus_cleanup``,
+    `/root/reference/k_llms/utils/consensus_utils.py:1355-1370`."""
+    if isinstance(obj, dict):
+        new_obj = {
+            k: w for k, v in obj.items() if (w := intermediary_consensus_cleanup(v)) is not None
+        }
+        return new_obj if new_obj else None
+    if isinstance(obj, (list, tuple)):
+        new_obj = [w for v in obj if (w := intermediary_consensus_cleanup(v)) is not None]
+        return new_obj if new_obj else None
+    if isinstance(obj, str):
+        stripped = obj.strip()
+        return stripped if stripped else None
+    return obj
