@@ -43,6 +43,9 @@ class BackendConfig(BaseModel):
     dtype: Optional[str] = None  # e.g. "bfloat16" | "float32"
     max_seq_len: Optional[int] = None
     attention_impl: Optional[str] = None  # "xla" | "flash"
+    # Weight quantization: None (model dtype) or "int8" (per-channel symmetric;
+    # halves decode HBM traffic, fits 8B-class weights on one v5e chip).
+    quantization: Optional[str] = None
 
 
 class TpuBackend(Backend):
@@ -68,6 +71,9 @@ class TpuBackend(Backend):
         if overrides:
             model_config = model_config.with_(**overrides)
         self.tokenizer = get_tokenizer(cfg.tokenizer_path)
+        if cfg.quantization not in (None, "int8"):
+            # Validate before the (potentially multi-GB) checkpoint load.
+            raise ValueError(f"Unsupported quantization {cfg.quantization!r}; use 'int8'")
         params = None
         if cfg.checkpoint_path:
             from ..models.loader import load_checkpoint
@@ -79,6 +85,7 @@ class TpuBackend(Backend):
             mesh=mesh,
             model_parallel=cfg.model_parallel,
             param_seed=cfg.param_seed,
+            quantize=cfg.quantization == "int8",
         )
         self.default_max_new_tokens = cfg.max_new_tokens
         # All device work funnels through one scheduler so concurrent clients
@@ -181,8 +188,10 @@ class TpuBackend(Backend):
     def crop_texts(
         self, texts: List[str], max_tokens: int, model: Optional[str] = None
     ) -> List[str]:
-        tok = self.tokenizer
-        return [tok.decode(tok.encode(t)[:max_tokens]) for t in texts]
+        # No-op on purpose: embeddings() enforces the same cap at the TOKEN
+        # level (encode-then-slice), so a client-side crop here would only add
+        # a redundant decode + re-encode round-trip on the embeddings hot path.
+        return list(texts)
 
     # -- llm-consensus ----------------------------------------------------
     def llm_consensus(self, values: List[str]) -> str:

@@ -24,7 +24,6 @@ MAX_TOKENS_PER_MODEL = {
     "text-embedding-3-large": 8191,
 }
 PRICING = {"local": 0.0, "text-embedding-3-small": 0.020, "text-embedding-3-large": 0.13}
-MAX_EMBEDDING_TOKENS = MAX_TOKENS_PER_MODEL["local"]
 
 
 def _progress_range(stop: int, step: int, verbose: bool):
@@ -40,14 +39,17 @@ def _progress_range(stop: int, step: int, verbose: bool):
 
 def _resolve_embedding_model(backend: Backend, model: str) -> str:
     """Map the sentinel "local" to whatever model the backend actually embeds
-    with, so crop caps and pricing follow the model that gets hit."""
-    effective = model if model != "local" else getattr(backend, "embedding_model_name", "local")
-    if effective not in MAX_TOKENS_PER_MODEL:
-        raise ValueError(
-            f"Model {effective} not supported. Available models: "
-            f"{list(MAX_TOKENS_PER_MODEL.keys())}"
-        )
-    return effective
+    with, so crop caps and pricing follow the model that gets hit. A model the
+    USER names must be known (reference `client.py:95-96`); a backend default
+    outside the table is allowed — it falls back to the default cap and $0."""
+    if model != "local":
+        if model not in MAX_TOKENS_PER_MODEL:
+            raise ValueError(
+                f"Model {model} not supported. Available models: "
+                f"{list(MAX_TOKENS_PER_MODEL.keys())}"
+            )
+        return model
+    return getattr(backend, "embedding_model_name", "local")
 
 
 def _embed_batches(
@@ -65,7 +67,7 @@ def _embed_batches(
     for idx in _progress_range(len(processed), batch_size, verbose):
         batch = processed[idx : idx + batch_size]
         vectors, prompt_tokens = backend.embeddings_with_usage(batch, model=model)
-        price_acc[0] += prompt_tokens * PRICING[model] / 1000000.0
+        price_acc[0] += prompt_tokens * PRICING.get(model, 0.0) / 1000000.0
         embeddings.extend(vectors)
 
 
@@ -99,7 +101,7 @@ class _BaseKLLMs:
         model, crop every text to the model's token cap, chunk by ``batch_size``,
         accumulate the billed price, report progress when ``verbose``."""
         model = _resolve_embedding_model(self._backend, model)
-        max_tokens = MAX_TOKENS_PER_MODEL[model]
+        max_tokens = MAX_TOKENS_PER_MODEL.get(model, MAX_TOKENS_PER_MODEL["local"])
         processed = self._backend.crop_texts(texts, max_tokens, model=model)
 
         embeddings: List[List[float]] = []
@@ -137,7 +139,7 @@ class AsyncKLLMs(_BaseKLLMs):
         import asyncio
 
         model = _resolve_embedding_model(self._backend, model)
-        max_tokens = MAX_TOKENS_PER_MODEL[model]
+        max_tokens = MAX_TOKENS_PER_MODEL.get(model, MAX_TOKENS_PER_MODEL["local"])
         backend = self._backend
 
         def selective_crop() -> List[str]:

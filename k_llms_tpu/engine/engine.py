@@ -67,23 +67,45 @@ class LocalEngine:
         model_parallel: Optional[int] = None,
         param_seed: int = 0,
         use_mesh: bool = True,
+        quantize: bool = False,
     ):
         self.config = get_config(config) if isinstance(config, str) else config
         if mesh is None and use_mesh and len(jax.devices()) > 1:
             mesh = auto_mesh(model_parallel=model_parallel)
         self.mesh = mesh
+        self.quantized = quantize
+
+        pspecs = param_specs(self.config)
+        if quantize:
+            from ..models.quant import quantize_params, quantized_param_specs
+
+            qspecs = quantized_param_specs(pspecs)
 
         if params is None:
-            init = partial(init_params, self.config)
+
+            def init(k):
+                p = init_params(self.config, k)
+                return quantize_params(p) if quantize else p
+
             if self.mesh is not None:
                 init = jax.jit(
-                    init, out_shardings=self._shard_tree(param_specs(self.config))
+                    init,
+                    out_shardings=self._shard_tree(qspecs if quantize else pspecs),
                 )
             else:
                 init = jax.jit(init)
             params = init(jax.random.key(param_seed))
-        elif self.mesh is not None:
-            params = jax.device_put(params, self._shard_tree(param_specs(self.config)))
+        else:
+            if quantize:
+                # Quantize on device (jitted) so the bf16 tree never has to fit
+                # alongside a second full copy in HBM per-shard.
+                qz = jax.jit(
+                    quantize_params,
+                    out_shardings=self._shard_tree(qspecs) if self.mesh is not None else None,
+                )
+                params = qz(params)
+            elif self.mesh is not None:
+                params = jax.device_put(params, self._shard_tree(pspecs))
         self.params = params
 
         self._prefill_cache: Dict[Any, Any] = {}

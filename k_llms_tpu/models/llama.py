@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .config import ModelConfig
+from .quant import qdot
 
 Params = Dict[str, Any]
 
@@ -174,9 +175,9 @@ def _block(
     scale = 1.0 / math.sqrt(config.head_dim)
 
     h = rms_norm(x, layer["attn_norm"], config.rms_eps)
-    q = (h @ layer["wq"]).reshape(B, Sq, config.num_heads, config.head_dim)
-    k = (h @ layer["wk"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
-    v = (h @ layer["wv"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
+    q = qdot(h, layer["wq"]).reshape(B, Sq, config.num_heads, config.head_dim)
+    k = qdot(h, layer["wk"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
+    v = qdot(h, layer["wv"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
 
     q = rope_embed(q, positions, config.rope_theta)
     k = rope_embed(k, positions, config.rope_theta)
@@ -213,12 +214,12 @@ def _block(
             interpret=jax.default_backend() != "tpu",
         ).transpose(0, 2, 1, 3)
         attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
-        x = x + attn @ layer["wo"]
+        x = x + qdot(attn, layer["wo"])
 
         h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
-        gate = jax.nn.silu(h @ layer["w_gate"])
-        up = h @ layer["w_up"]
-        x = x + (gate * up) @ layer["w_down"]
+        gate = jax.nn.silu(qdot(h, layer["w_gate"]))
+        up = qdot(h, layer["w_up"])
+        x = x + qdot(gate * up, layer["w_down"])
         return x, (cache_k, cache_v)
 
     scores = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, Smax] f32
@@ -238,12 +239,12 @@ def _block(
         attn = _gqa_values(weights, cache_v)
 
     attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
-    x = x + attn @ layer["wo"]
+    x = x + qdot(attn, layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
-    gate = jax.nn.silu(h @ layer["w_gate"])
-    up = h @ layer["w_up"]
-    x = x + (gate * up) @ layer["w_down"]
+    gate = jax.nn.silu(qdot(h, layer["w_gate"]))
+    up = qdot(h, layer["w_up"])
+    x = x + qdot(gate * up, layer["w_down"])
     return x, (cache_k, cache_v)
 
 
@@ -328,7 +329,7 @@ def forward(
         config, params, x, positions, cache, None, key_mask, key_lengths=key_lengths
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    logits = qdot(h, params["lm_head"]).astype(jnp.float32)
     return logits, h
 
 
@@ -356,7 +357,7 @@ def prefill(
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps)
     last = jnp.take_along_axis(h, (prompt_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1)
-    logits = (last[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = qdot(last[:, 0, :], params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
 
@@ -399,5 +400,5 @@ def decode_step(
         prefix_mask=prefix_mask,
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps)
-    logits = (h[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = qdot(h[:, 0, :], params["lm_head"]).astype(jnp.float32)
     return logits, gen_cache

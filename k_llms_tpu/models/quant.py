@@ -1,0 +1,98 @@
+"""Int8 weight-only quantization for the matmul weights.
+
+The reference has no model layer at all (its "engine" is the OpenAI HTTP API,
+`/root/reference/k_llms/resources/completions/completions.py:73`); this is a
+capability of the local TPU engine. Autoregressive decode is HBM-bandwidth
+bound: every step streams the full weight set from HBM. Storing matmul weights
+as int8 (symmetric, per-output-channel scales) halves that traffic, and lets
+8B-class weights fit a single v5e chip (16 GB HBM) with room for KV caches.
+
+Design: a :class:`QTensor` pytree (int8 payload + f32 scale) flows through the
+same params tree, ``lax.scan``, and ``pjit`` shardings as the bf16 weights.
+``qdot(x, w)`` dispatches on the weight type, so the model code in
+``models/llama.py`` is quantization-agnostic: the int8→bf16 cast happens inside
+the fused matmul (weights are read from HBM as int8; the per-channel scale is
+applied to the matmul output, so no dequantized copy is ever materialized).
+Embeddings and norms stay bf16 — lookups only stream the rows they touch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class QTensor(NamedTuple):
+    """Symmetric per-output-channel int8 weight: ``q`` has the weight's shape
+    [..., in, out]; ``scale`` is f32 [..., 1, out]."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+WeightLike = Union[jax.Array, QTensor]
+
+# Matmul weights to quantize (all contract over axis -2). Embeddings and norms
+# stay in the model dtype.
+_QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jax.Array) -> QTensor:
+    """Symmetric int8 per-output-channel: scale over the contraction axis (-2)."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
+    """``x @ w`` for a plain array or a QTensor. For QTensor the int8 payload is
+    cast inside the matmul (HBM reads stay int8) and the per-channel scale is
+    applied to the output."""
+    if isinstance(w, QTensor):
+        out = x @ w.q.astype(x.dtype)
+        return out * w.scale[..., 0, :].astype(out.dtype)
+    return x @ w
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize the seven block matmuls and lm_head; leave embed/norms as-is."""
+    layers = dict(params["layers"])
+    for key in _QUANT_LAYER_KEYS:
+        layers[key] = quantize_weight(layers[key])
+    out = dict(params)
+    out["layers"] = layers
+    out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
+
+
+def quantized_param_specs(specs: Dict[str, Any]) -> Dict[str, Any]:
+    """Map a bf16 param-spec tree to the quantized tree: the int8 payload keeps
+    the weight's spec; the scale keeps it too except on the contraction axis
+    (size 1 after the keepdims reduce — an axis of size 1 can't shard)."""
+
+    def scale_spec(spec: P) -> P:
+        parts = list(spec)
+        if len(parts) >= 2:
+            parts[-2] = None
+        return P(*parts)
+
+    layers = dict(specs["layers"])
+    for key in _QUANT_LAYER_KEYS:
+        layers[key] = QTensor(q=layers[key], scale=scale_spec(layers[key]))
+    out = dict(specs)
+    out["layers"] = layers
+    out["lm_head"] = QTensor(q=specs["lm_head"], scale=scale_spec(specs["lm_head"]))
+    return out

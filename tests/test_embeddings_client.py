@@ -139,12 +139,32 @@ def test_local_model_resolves_to_backend_default():
     assert backend.models_seen == ["text-embedding-3-small"]
 
 
-def test_tpu_tokenizer_crop():
+def test_tpu_crop_is_noop_cap_enforced_at_token_level():
     from k_llms_tpu.backends.tpu import TpuBackend
 
     backend = TpuBackend(model="tiny")
-    cropped = backend.crop_texts(["abcdefgh", "xy"], max_tokens=4)
-    assert cropped == ["abcd", "xy"]  # byte tokenizer: 1 token per byte
+    # crop_texts passes through: embeddings() itself slices the token lists at
+    # the cap, so the client-side crop would only double the tokenization work.
+    assert backend.crop_texts(["abcdefgh", "xy"], max_tokens=4) == ["abcdefgh", "xy"]
+    # Same text cropped at the cap vs beyond it embeds identically.
+    long = "q" * 20000
+    short = long[:8191]  # byte tokenizer: 1 token per char, cap 8191
+    e_long = backend.embeddings([long])[0]
+    e_short = backend.embeddings([short])[0]
+    assert e_long == e_short
+
+
+def test_unknown_backend_default_model_is_tolerated():
+    backend = RecordingBackend()
+    backend.embedding_model_name = "custom-embedder-v2"
+    client = KLLMs(backend=backend)
+    # "local" resolves to an out-of-table backend default: default cap, $0 price.
+    out = client.get_embeddings(["hello"], model="local")
+    assert out == [[5.0]]
+    assert backend.models_seen == ["custom-embedder-v2"]
+    # A USER-named unknown model still errors (reference behavior).
+    with pytest.raises(ValueError, match="not supported"):
+        client.get_embeddings(["hello"], model="custom-embedder-v2")
 
 
 # --- standalone consensus helpers -------------------------------------------
