@@ -44,6 +44,10 @@ class Backend(abc.ABC):
     #: requested model of "local" to this so pricing follows the model actually hit.
     embedding_model_name: str = "local"
 
+    #: True for backends whose embedding calls cost real money (the client then
+    #: refuses default models it cannot price instead of billing them at $0).
+    bills_usage: bool = False
+
     def embeddings_with_usage(
         self, texts: List[str], model: Optional[str] = None
     ) -> "tuple[List[List[float]], int]":

@@ -15,6 +15,8 @@ from .base import Backend, ChatRequest
 
 
 class OpenAIBackend(Backend):
+    bills_usage = True
+
     def __init__(
         self,
         api_key: Optional[str] = None,

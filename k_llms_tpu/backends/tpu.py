@@ -188,10 +188,14 @@ class TpuBackend(Backend):
     def crop_texts(
         self, texts: List[str], max_tokens: int, model: Optional[str] = None
     ) -> List[str]:
-        # No-op on purpose: embeddings() enforces the same cap at the TOKEN
-        # level (encode-then-slice), so a client-side crop here would only add
-        # a redundant decode + re-encode round-trip on the embeddings hot path.
-        return list(texts)
+        # Real token-level crop per the Backend contract. embeddings() slices
+        # at MAX_EMBEDDING_TOKENS anyway (its own callers pass raw strings), so
+        # already-cropped client inputs just pass through the slice unchanged.
+        tok = self.tokenizer
+        return [
+            t if len(t) <= max_tokens else tok.decode(tok.encode(t)[:max_tokens])
+            for t in texts
+        ]
 
     # -- llm-consensus ----------------------------------------------------
     def llm_consensus(self, values: List[str]) -> str:

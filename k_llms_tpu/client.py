@@ -49,7 +49,15 @@ def _resolve_embedding_model(backend: Backend, model: str) -> str:
                 f"{list(MAX_TOKENS_PER_MODEL.keys())}"
             )
         return model
-    return getattr(backend, "embedding_model_name", "local")
+    effective = getattr(backend, "embedding_model_name", "local")
+    if effective not in PRICING and getattr(backend, "bills_usage", False):
+        # A PAID backend defaulting to a model we can't price must fail loudly
+        # rather than silently billing $0; free/local custom embedders pass.
+        raise ValueError(
+            f"Model {effective} not supported. Available models: "
+            f"{list(MAX_TOKENS_PER_MODEL.keys())}"
+        )
+    return effective
 
 
 def _embed_batches(

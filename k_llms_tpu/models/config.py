@@ -31,6 +31,12 @@ class ModelConfig:
     # "flash" (Pallas TPU kernel, ops/attention.py; ~1.3x prefill attention
     # speedup at 2k context on v5e). Decode always uses the XLA path (Sq=1).
     attention_impl: str = "xla"
+    # Architecture variants beyond Llama:
+    # - qkv_bias: additive bias on q/k/v projections (Qwen2 family).
+    # - sliding_window: each query attends only to the last W keys
+    #   (Mistral family); None = full causal. Forces the XLA attention path.
+    qkv_bias: bool = False
+    sliding_window: "int | None" = None
     # byte tokenizer vocab fits any vocab_size >= 260; HF tokenizers use the full space
     bos_token_id: int = 256
     eos_token_id: int = 257
@@ -113,6 +119,70 @@ register_config(
         num_kv_heads=8,
         head_dim=128,
         max_seq_len=4096,
+    )
+)
+
+# Qwen2 family: Llama architecture + QKV biases, 1e6 rope theta.
+register_config(
+    ModelConfig(
+        name="qwen2-7b",
+        attention_impl="flash",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        max_seq_len=8192,
+        qkv_bias=True,
+        bos_token_id=151643,
+        eos_token_id=151645,
+        pad_token_id=151643,
+    )
+)
+
+register_config(
+    ModelConfig(
+        name="qwen2.5-0.5b",
+        attention_impl="flash",
+        vocab_size=151936,
+        hidden_size=896,
+        intermediate_size=4864,
+        num_layers=24,
+        num_heads=14,
+        num_kv_heads=2,
+        head_dim=64,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        max_seq_len=8192,
+        qkv_bias=True,
+        bos_token_id=151643,
+        eos_token_id=151645,
+        pad_token_id=151643,
+    )
+)
+
+# Mistral family: Llama architecture + sliding-window attention.
+register_config(
+    ModelConfig(
+        name="mistral-7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=10000.0,
+        rms_eps=1e-5,
+        max_seq_len=8192,
+        sliding_window=4096,
+        bos_token_id=1,
+        eos_token_id=2,
+        pad_token_id=2,
     )
 )
 

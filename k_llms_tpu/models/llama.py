@@ -68,19 +68,24 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
     ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "wq": normal(ks[0], (L, H, Q), 1.0 / math.sqrt(H)),
+        "wk": normal(ks[1], (L, H, KV), 1.0 / math.sqrt(H)),
+        "wv": normal(ks[2], (L, H, KV), 1.0 / math.sqrt(H)),
+        "wo": normal(ks[3], (L, Q, H), 1.0 / math.sqrt(Q)),
+        "mlp_norm": jnp.ones((L, H), dtype),
+        "w_gate": normal(ks[4], (L, H, I), 1.0 / math.sqrt(H)),
+        "w_up": normal(ks[5], (L, H, I), 1.0 / math.sqrt(H)),
+        "w_down": normal(ks[6], (L, I, H), 1.0 / math.sqrt(I)),
+    }
+    if config.qkv_bias:  # Qwen2 family
+        layers["bq"] = jnp.zeros((L, Q), dtype)
+        layers["bk"] = jnp.zeros((L, KV), dtype)
+        layers["bv"] = jnp.zeros((L, KV), dtype)
     params: Params = {
         "embed": normal(k_embed, (V, H), 1.0 / math.sqrt(H)),
-        "layers": {
-            "attn_norm": jnp.ones((L, H), dtype),
-            "wq": normal(ks[0], (L, H, Q), 1.0 / math.sqrt(H)),
-            "wk": normal(ks[1], (L, H, KV), 1.0 / math.sqrt(H)),
-            "wv": normal(ks[2], (L, H, KV), 1.0 / math.sqrt(H)),
-            "wo": normal(ks[3], (L, Q, H), 1.0 / math.sqrt(Q)),
-            "mlp_norm": jnp.ones((L, H), dtype),
-            "w_gate": normal(ks[4], (L, H, I), 1.0 / math.sqrt(H)),
-            "w_up": normal(ks[5], (L, H, I), 1.0 / math.sqrt(H)),
-            "w_down": normal(ks[6], (L, I, H), 1.0 / math.sqrt(I)),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((H,), dtype),
         "lm_head": normal(k_head, (H, V), 1.0 / math.sqrt(H)),
     }
@@ -175,9 +180,12 @@ def _block(
     scale = 1.0 / math.sqrt(config.head_dim)
 
     h = rms_norm(x, layer["attn_norm"], config.rms_eps)
-    q = qdot(h, layer["wq"]).reshape(B, Sq, config.num_heads, config.head_dim)
-    k = qdot(h, layer["wk"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
-    v = qdot(h, layer["wv"]).reshape(B, Sq, config.num_kv_heads, config.head_dim)
+    q, k, v = qdot(h, layer["wq"]), qdot(h, layer["wk"]), qdot(h, layer["wv"])
+    if "bq" in layer:  # Qwen2-family QKV biases (static per-config structure)
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, Sq, config.num_heads, config.head_dim)
+    k = k.reshape(B, Sq, config.num_kv_heads, config.head_dim)
+    v = v.reshape(B, Sq, config.num_kv_heads, config.head_dim)
 
     q = rope_embed(q, positions, config.rope_theta)
     k = rope_embed(k, positions, config.rope_theta)
@@ -198,6 +206,7 @@ def _block(
     # masking + causal structure are exactly what the kernel supports.
     if (
         config.attention_impl == "flash"
+        and config.sliding_window is None
         and write_index is None
         and prefix_kv is None
         and key_lengths is not None
@@ -321,6 +330,8 @@ def forward(
     x = jnp.take(params["embed"], tokens, axis=0)
 
     causal = jnp.tril(jnp.ones((S, S), bool))
+    if config.sliding_window is not None:  # Mistral: query i sees keys (i-W, i]
+        causal &= jnp.triu(jnp.ones((S, S), bool), -(config.sliding_window - 1))
     key_mask = causal[None, :, :] & pad_mask[:, None, :].astype(bool)
 
     cache = init_cache(config, B, S)
@@ -347,6 +358,8 @@ def prefill(
     x = jnp.take(params["embed"], tokens, axis=0)
 
     causal = jnp.tril(jnp.ones((S, S), bool))
+    if config.sliding_window is not None:
+        causal &= jnp.triu(jnp.ones((S, S), bool), -(config.sliding_window - 1))
     valid = jnp.arange(S)[None, :] < prompt_len  # [1, S]
     key_mask = causal[None, :, :] & valid[:, None, :]
 
@@ -387,6 +400,12 @@ def decode_step(
     self_mask = (jnp.arange(G)[None, None, :] <= step) & jnp.ones((B, 1, 1), bool)
     # Prefix keys: positions < prompt_len are valid.
     prefix_mask = (jnp.arange(P)[None, None, :] < prompt_len) & jnp.ones((1, 1, 1), bool)
+    if config.sliding_window is not None:
+        # Query position is prompt_len + step; key position k is visible iff
+        # q_pos - k_pos < W. Gen slot s sits at position prompt_len + s.
+        W = config.sliding_window
+        self_mask &= jnp.arange(G)[None, None, :] > step - W
+        prefix_mask &= jnp.arange(P)[None, None, :] > prompt_len + step - W
 
     x, gen_cache = _apply_stack(
         config,

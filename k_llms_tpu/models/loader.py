@@ -83,6 +83,15 @@ def load_safetensors(path: str, config: ModelConfig, dtype=None) -> Dict[str, An
         "w_down": np.stack([t(_hf_key(i, "mlp.down_proj")) for i in range(L)]),
     }
 
+    if config.qkv_bias:  # Qwen2 family
+        for ours, hf_name in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
+            layers[ours] = np.stack(
+                [
+                    np.asarray(tensors[f"model.layers.{i}.self_attn.{hf_name}.bias"])
+                    for i in range(L)
+                ]
+            )
+
     embed = np.asarray(tensors["model.embed_tokens.weight"])
     if "lm_head.weight" in tensors:
         lm_head = np.asarray(tensors["lm_head.weight"]).T
@@ -114,7 +123,15 @@ def config_from_hf(path: str) -> Optional[ModelConfig]:
         hf = json.load(f)
     hidden = hf["hidden_size"]
     heads = hf["num_attention_heads"]
+    model_type = hf.get("model_type", "llama")
+    # Qwen2 ships a huge nominal sliding_window with use_sliding_window=false;
+    # Mistral configs carry the real window (or null for v0.3+).
+    sliding_window = hf.get("sliding_window")
+    if model_type == "qwen2" and not hf.get("use_sliding_window", False):
+        sliding_window = None
     return ModelConfig(
+        qkv_bias=model_type == "qwen2" or hf.get("attention_bias", False),
+        sliding_window=sliding_window,
         name=os.path.basename(os.path.normpath(path)),
         vocab_size=hf["vocab_size"],
         hidden_size=hidden,

@@ -20,19 +20,25 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 
 def param_specs(config: ModelConfig) -> Dict[str, Any]:
     """Pytree of PartitionSpec matching models.llama.init_params."""
+    layers = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, MODEL_AXIS),
+        "wk": P(None, None, MODEL_AXIS),
+        "wv": P(None, None, MODEL_AXIS),
+        "wo": P(None, MODEL_AXIS, None),
+        "mlp_norm": P(None, None),
+        "w_gate": P(None, None, MODEL_AXIS),
+        "w_up": P(None, None, MODEL_AXIS),
+        "w_down": P(None, MODEL_AXIS, None),
+    }
+    if config.qkv_bias:
+        # Biases follow their projection's output-feature sharding.
+        layers["bq"] = P(None, MODEL_AXIS)
+        layers["bk"] = P(None, MODEL_AXIS)
+        layers["bv"] = P(None, MODEL_AXIS)
     return {
         "embed": P(MODEL_AXIS, None),  # vocab-sharded
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, MODEL_AXIS),
-            "wk": P(None, None, MODEL_AXIS),
-            "wv": P(None, None, MODEL_AXIS),
-            "wo": P(None, MODEL_AXIS, None),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, None, MODEL_AXIS),
-            "w_up": P(None, None, MODEL_AXIS),
-            "w_down": P(None, MODEL_AXIS, None),
-        },
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P(None, MODEL_AXIS),
     }

@@ -139,19 +139,25 @@ def test_local_model_resolves_to_backend_default():
     assert backend.models_seen == ["text-embedding-3-small"]
 
 
-def test_tpu_crop_is_noop_cap_enforced_at_token_level():
+def test_tpu_tokenizer_crop():
     from k_llms_tpu.backends.tpu import TpuBackend
 
     backend = TpuBackend(model="tiny")
-    # crop_texts passes through: embeddings() itself slices the token lists at
-    # the cap, so the client-side crop would only double the tokenization work.
-    assert backend.crop_texts(["abcdefgh", "xy"], max_tokens=4) == ["abcdefgh", "xy"]
-    # Same text cropped at the cap vs beyond it embeds identically.
+    # Byte tokenizer: 1 token per byte; short texts skip the encode round-trip.
+    assert backend.crop_texts(["abcdefgh", "xy"], max_tokens=4) == ["abcd", "xy"]
+    # The internal cap in embeddings() agrees with the crop: same vectors.
     long = "q" * 20000
-    short = long[:8191]  # byte tokenizer: 1 token per char, cap 8191
-    e_long = backend.embeddings([long])[0]
-    e_short = backend.embeddings([short])[0]
-    assert e_long == e_short
+    short = long[:8191]
+    assert backend.embeddings([long])[0] == backend.embeddings([short])[0]
+
+
+def test_paid_backend_unknown_default_model_errors():
+    backend = RecordingBackend()
+    backend.embedding_model_name = "text-embedding-ada-002"
+    backend.bills_usage = True  # paid backend: a $0 fallback would mis-bill
+    client = KLLMs(backend=backend)
+    with pytest.raises(ValueError, match="not supported"):
+        client.get_embeddings(["x"], model="local")
 
 
 def test_unknown_backend_default_model_is_tolerated():
