@@ -35,8 +35,27 @@ class ModelConfig:
     # - qkv_bias: additive bias on q/k/v projections (Qwen2 family).
     # - sliding_window: each query attends only to the last W keys
     #   (Mistral family); None = full causal. Forces the XLA attention path.
+    # - sliding_window_layers: "all" (every layer windowed — Mistral) or
+    #   "alternating" (even layers windowed, odd layers global — Gemma-2).
     qkv_bias: bool = False
     sliding_window: "int | None" = None
+    sliding_window_layers: str = "all"
+    # Gemma-family variants:
+    # - act: MLP gate activation, "silu" (Llama) or "gelu" (GeGLU).
+    # - norm_offset: RMSNorm scales by (1 + w) instead of w.
+    # - embed_scale: multiply token embeddings by sqrt(hidden_size).
+    # - post_block_norms: Gemma-2 extra norms on the attention and MLP outputs
+    #   (before each residual add).
+    # - attn_softcap / logit_softcap: cap*tanh(x/cap) on attention scores /
+    #   final logits. Softcaps force the XLA attention path.
+    # - query_scale: attention score scale; None = 1/sqrt(head_dim).
+    act: str = "silu"
+    norm_offset: bool = False
+    embed_scale: bool = False
+    post_block_norms: bool = False
+    attn_softcap: "float | None" = None
+    logit_softcap: "float | None" = None
+    query_scale: "float | None" = None
     # byte tokenizer vocab fits any vocab_size >= 260; HF tokenizers use the full space
     bos_token_id: int = 256
     eos_token_id: int = 257
@@ -119,6 +138,65 @@ register_config(
         num_kv_heads=8,
         head_dim=128,
         max_seq_len=4096,
+    )
+)
+
+# Gemma-2 family: GeGLU, (1+w) RMSNorm, post-block norms, sqrt(H) embedding
+# scale, attention + final-logit softcaps, alternating local/global attention,
+# tied embeddings, big head_dim with a fixed query scale.
+register_config(
+    ModelConfig(
+        name="gemma-2-2b",
+        vocab_size=256128,
+        hidden_size=2304,
+        intermediate_size=9216,
+        num_layers=26,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=256,
+        rope_theta=10000.0,
+        rms_eps=1e-6,
+        max_seq_len=8192,
+        sliding_window=4096,
+        sliding_window_layers="alternating",
+        act="gelu",
+        norm_offset=True,
+        embed_scale=True,
+        post_block_norms=True,
+        attn_softcap=50.0,
+        logit_softcap=30.0,
+        query_scale=256.0**-0.5,  # query_pre_attn_scalar=256
+        bos_token_id=2,
+        eos_token_id=1,
+        pad_token_id=0,
+    )
+)
+
+register_config(
+    ModelConfig(
+        name="gemma-2-9b",
+        vocab_size=256128,
+        hidden_size=3584,
+        intermediate_size=14336,
+        num_layers=42,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=256,
+        rope_theta=10000.0,
+        rms_eps=1e-6,
+        max_seq_len=8192,
+        sliding_window=4096,
+        sliding_window_layers="alternating",
+        act="gelu",
+        norm_offset=True,
+        embed_scale=True,
+        post_block_norms=True,
+        attn_softcap=50.0,
+        logit_softcap=30.0,
+        query_scale=256.0**-0.5,
+        bos_token_id=2,
+        eos_token_id=1,
+        pad_token_id=0,
     )
 )
 

@@ -68,13 +68,15 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
     ks = jax.random.split(k_layers, 7)
+    # Offset norms (Gemma) store w with effective scale (1 + w): identity is 0.
+    norm_init = jnp.zeros if config.norm_offset else jnp.ones
     layers = {
-        "attn_norm": jnp.ones((L, H), dtype),
+        "attn_norm": norm_init((L, H), dtype),
         "wq": normal(ks[0], (L, H, Q), 1.0 / math.sqrt(H)),
         "wk": normal(ks[1], (L, H, KV), 1.0 / math.sqrt(H)),
         "wv": normal(ks[2], (L, H, KV), 1.0 / math.sqrt(H)),
         "wo": normal(ks[3], (L, Q, H), 1.0 / math.sqrt(Q)),
-        "mlp_norm": jnp.ones((L, H), dtype),
+        "mlp_norm": norm_init((L, H), dtype),
         "w_gate": normal(ks[4], (L, H, I), 1.0 / math.sqrt(H)),
         "w_up": normal(ks[5], (L, H, I), 1.0 / math.sqrt(H)),
         "w_down": normal(ks[6], (L, I, H), 1.0 / math.sqrt(I)),
@@ -83,10 +85,13 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
         layers["bq"] = jnp.zeros((L, Q), dtype)
         layers["bk"] = jnp.zeros((L, KV), dtype)
         layers["bv"] = jnp.zeros((L, KV), dtype)
+    if config.post_block_norms:  # Gemma-2: norms on attention/MLP outputs
+        layers["post_attn_norm"] = norm_init((L, H), dtype)
+        layers["post_mlp_norm"] = norm_init((L, H), dtype)
     params: Params = {
         "embed": normal(k_embed, (V, H), 1.0 / math.sqrt(H)),
         "layers": layers,
-        "final_norm": jnp.ones((H,), dtype),
+        "final_norm": norm_init((H,), dtype),
         "lm_head": normal(k_head, (H, V), 1.0 / math.sqrt(H)),
     }
     return params
@@ -96,10 +101,22 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
 # Building blocks
 # ---------------------------------------------------------------------------
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float, offset: bool = False) -> jax.Array:
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (x32 * scale).astype(x.dtype) * weight
+    w = (1.0 + weight.astype(jnp.float32)).astype(x.dtype) if offset else weight
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def _softcap(x: jax.Array, cap: float) -> jax.Array:
+    """Gemma-2 soft capping: cap * tanh(x / cap)."""
+    return cap * jnp.tanh(x / cap)
+
+
+def _activation(config: ModelConfig, x: jax.Array) -> jax.Array:
+    if config.act == "gelu":  # GeGLU (Gemma): tanh-approximate gelu
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def rope_embed(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -177,9 +194,10 @@ def _block(
     and [1|B, Sq, P].
     """
     B, Sq, H = x.shape
-    scale = 1.0 / math.sqrt(config.head_dim)
+    scale = config.query_scale or 1.0 / math.sqrt(config.head_dim)
+    offset = config.norm_offset
 
-    h = rms_norm(x, layer["attn_norm"], config.rms_eps)
+    h = rms_norm(x, layer["attn_norm"], config.rms_eps, offset)
     q, k, v = qdot(h, layer["wq"]), qdot(h, layer["wk"]), qdot(h, layer["wv"])
     if "bq" in layer:  # Qwen2-family QKV biases (static per-config structure)
         q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
@@ -202,11 +220,28 @@ def _block(
             cache_v, v.astype(cache_v.dtype), write_index, axis=1
         )
 
+    def mlp(x: jax.Array) -> jax.Array:
+        h = rms_norm(x, layer["mlp_norm"], config.rms_eps, offset)
+        gate = _activation(config, qdot(h, layer["w_gate"]))
+        up = qdot(h, layer["w_up"])
+        out = qdot(gate * up, layer["w_down"])
+        if "post_mlp_norm" in layer:
+            out = rms_norm(out, layer["post_mlp_norm"], config.rms_eps, offset)
+        return x + out
+
+    def attn_out(attn: jax.Array) -> jax.Array:
+        out = qdot(attn, layer["wo"])
+        if "post_attn_norm" in layer:
+            out = rms_norm(out, layer["post_attn_norm"], config.rms_eps, offset)
+        return x + out
+
     # Full-sequence prefill can take the Pallas flash path: prefix-length
-    # masking + causal structure are exactly what the kernel supports.
+    # masking + causal structure are exactly what the kernel supports (softcaps
+    # and windowed layers are not — they keep the XLA path).
     if (
         config.attention_impl == "flash"
         and config.sliding_window is None
+        and config.attn_softcap is None
         and write_index is None
         and prefix_kv is None
         and key_lengths is not None
@@ -223,21 +258,19 @@ def _block(
             interpret=jax.default_backend() != "tpu",
         ).transpose(0, 2, 1, 3)
         attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
-        x = x + qdot(attn, layer["wo"])
-
-        h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
-        gate = jax.nn.silu(qdot(h, layer["w_gate"]))
-        up = qdot(h, layer["w_up"])
-        x = x + qdot(gate * up, layer["w_down"])
-        return x, (cache_k, cache_v)
+        return mlp(attn_out(attn)), (cache_k, cache_v)
 
     scores = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, Smax] f32
+    if config.attn_softcap is not None:
+        scores = _softcap(scores, config.attn_softcap)
     neg = jnp.finfo(jnp.float32).min
     scores = jnp.where(key_mask[:, None, :, :], scores, neg)
 
     if prefix_kv is not None:
         pk, pv = prefix_kv
         p_scores = _gqa_scores_shared(q, pk) * scale  # [B, QH, Sq, P]
+        if config.attn_softcap is not None:
+            p_scores = _softcap(p_scores, config.attn_softcap)
         p_scores = jnp.where(prefix_mask[:, None, :, :], p_scores, neg)
         all_scores = jnp.concatenate([p_scores, scores], axis=-1)
         weights = jax.nn.softmax(all_scores, axis=-1)
@@ -248,13 +281,16 @@ def _block(
         attn = _gqa_values(weights, cache_v)
 
     attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
-    x = x + qdot(attn, layer["wo"])
+    return mlp(attn_out(attn)), (cache_k, cache_v)
 
-    h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
-    gate = jax.nn.silu(qdot(h, layer["w_gate"]))
-    up = qdot(h, layer["w_up"])
-    x = x + qdot(gate * up, layer["w_down"])
-    return x, (cache_k, cache_v)
+
+def _local_layer_flags(config: ModelConfig) -> Optional[jax.Array]:
+    """[L] bool: layer uses the windowed mask. None when no per-layer mixing
+    (full causal everywhere, or every layer windowed)."""
+    if config.sliding_window is None or config.sliding_window_layers == "all":
+        return None
+    # "alternating" (Gemma-2): even layers local, odd layers global.
+    return jnp.arange(config.num_layers) % 2 == 0
 
 
 def _apply_stack(
@@ -268,15 +304,32 @@ def _apply_stack(
     prefix: Optional[KVCache] = None,
     prefix_mask: Optional[jax.Array] = None,
     key_lengths: Optional[jax.Array] = None,
+    key_mask_global: Optional[jax.Array] = None,
+    prefix_mask_global: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, KVCache]:
-    """Scan the layer stack. cache k/v: [L, B, Smax, KVH, D]."""
+    """Scan the layer stack. cache k/v: [L, B, Smax, KVH, D].
+
+    When layers alternate local/global attention (Gemma-2), ``key_mask`` /
+    ``prefix_mask`` hold the WINDOWED masks, the ``*_global`` twins hold the
+    full-causal ones, and a scanned per-layer flag picks between them.
+    """
+    local_flags = _local_layer_flags(config) if key_mask_global is not None else None
 
     def body(carry, scanned):
         x = carry
-        layer_params, layer_kv, layer_prefix = scanned
+        layer_params, layer_kv, layer_prefix, flag = scanned
         prefix_kv = None
         if layer_prefix is not None:
             prefix_kv = (layer_prefix[0], layer_prefix[1])
+        if flag is None:
+            km, pm = key_mask, prefix_mask
+        else:
+            km = jnp.where(flag, key_mask, key_mask_global)
+            pm = (
+                jnp.where(flag, prefix_mask, prefix_mask_global)
+                if prefix_mask is not None
+                else None
+            )
         x, new_kv = _block(
             config,
             layer_params,
@@ -284,9 +337,9 @@ def _apply_stack(
             positions,
             (layer_kv[0], layer_kv[1]),
             write_index,
-            key_mask,
+            km,
             prefix_kv=prefix_kv,
-            prefix_mask=prefix_mask,
+            prefix_mask=pm,
             key_lengths=key_lengths,
         )
         return x, new_kv
@@ -295,17 +348,29 @@ def _apply_stack(
     kv_stacked = (cache.k, cache.v)
     prefix_stacked = (prefix.k, prefix.v) if prefix is not None else None
 
-    if prefix_stacked is None:
+    # lax.scan needs every scanned leaf to exist; encode the optional slots
+    # statically by building the xs tuple (and matching unpack) per case.
+    if prefix_stacked is None and local_flags is None:
         x, new_kv = lax.scan(
-            lambda c, s: body(c, (s[0], s[1], None)),
+            lambda c, s: body(c, (s[0], s[1], None, None)), x, (layers, kv_stacked)
+        )
+    elif prefix_stacked is None:
+        x, new_kv = lax.scan(
+            lambda c, s: body(c, (s[0], s[1], None, s[2])),
             x,
-            (layers, kv_stacked),
+            (layers, kv_stacked, local_flags),
+        )
+    elif local_flags is None:
+        x, new_kv = lax.scan(
+            lambda c, s: body(c, (s[0], s[1], s[2], None)),
+            x,
+            (layers, kv_stacked, prefix_stacked),
         )
     else:
         x, new_kv = lax.scan(
-            lambda c, s: body(c, (s[0], s[1], s[2])),
+            lambda c, s: body(c, (s[0], s[1], s[2], s[3])),
             x,
-            (layers, kv_stacked, prefix_stacked),
+            (layers, kv_stacked, prefix_stacked, local_flags),
         )
 
     return x, KVCache(k=new_kv[0], v=new_kv[1])
@@ -314,6 +379,20 @@ def _apply_stack(
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
+
+def _embed(config: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    x = jnp.take(params["embed"], tokens, axis=0)
+    if config.embed_scale:  # Gemma: normalize embedding magnitude
+        x = x * jnp.asarray(math.sqrt(config.hidden_size), x.dtype)
+    return x
+
+
+def _logits(config: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
+    logits = qdot(h, params["lm_head"]).astype(jnp.float32)
+    if config.logit_softcap is not None:
+        logits = _softcap(logits, config.logit_softcap)
+    return logits
+
 
 def forward(
     config: ModelConfig,
@@ -327,20 +406,32 @@ def forward(
     B, S = tokens.shape
     positions = jnp.cumsum(pad_mask.astype(jnp.int32), axis=1) - 1
     positions = jnp.maximum(positions, 0)
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed(config, params, tokens)
 
     causal = jnp.tril(jnp.ones((S, S), bool))
-    if config.sliding_window is not None:  # Mistral: query i sees keys (i-W, i]
-        causal &= jnp.triu(jnp.ones((S, S), bool), -(config.sliding_window - 1))
+    key_mask_global = None
+    if config.sliding_window is not None:  # query i sees keys (i-W, i]
+        band = causal & jnp.triu(jnp.ones((S, S), bool), -(config.sliding_window - 1))
+        if config.sliding_window_layers == "alternating":
+            key_mask_global = causal[None, :, :] & pad_mask[:, None, :].astype(bool)
+        causal = band
     key_mask = causal[None, :, :] & pad_mask[:, None, :].astype(bool)
 
     cache = init_cache(config, B, S)
     key_lengths = pad_mask.astype(jnp.int32).sum(axis=1)
     x, _ = _apply_stack(
-        config, params, x, positions, cache, None, key_mask, key_lengths=key_lengths
+        config,
+        params,
+        x,
+        positions,
+        cache,
+        None,
+        key_mask,
+        key_lengths=key_lengths,
+        key_mask_global=key_mask_global,
     )
-    h = rms_norm(x, params["final_norm"], config.rms_eps)
-    logits = qdot(h, params["lm_head"]).astype(jnp.float32)
+    h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
+    logits = _logits(config, params, h)
     return logits, h
 
 
@@ -355,22 +446,34 @@ def prefill(
     prefix KVCache [L, 1, S, KVH, D])."""
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed(config, params, tokens)
 
     causal = jnp.tril(jnp.ones((S, S), bool))
-    if config.sliding_window is not None:
-        causal &= jnp.triu(jnp.ones((S, S), bool), -(config.sliding_window - 1))
     valid = jnp.arange(S)[None, :] < prompt_len  # [1, S]
+    key_mask_global = None
+    if config.sliding_window is not None:
+        band = causal & jnp.triu(jnp.ones((S, S), bool), -(config.sliding_window - 1))
+        if config.sliding_window_layers == "alternating":
+            key_mask_global = causal[None, :, :] & valid[:, None, :]
+        causal = band
     key_mask = causal[None, :, :] & valid[:, None, :]
 
     cache = init_cache(config, B, S)
     key_lengths = jnp.broadcast_to(prompt_len, (B,)).astype(jnp.int32)
     x, cache = _apply_stack(
-        config, params, x, positions, cache, None, key_mask, key_lengths=key_lengths
+        config,
+        params,
+        x,
+        positions,
+        cache,
+        None,
+        key_mask,
+        key_lengths=key_lengths,
+        key_mask_global=key_mask_global,
     )
-    h = rms_norm(x, params["final_norm"], config.rms_eps)
+    h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     last = jnp.take_along_axis(h, (prompt_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1)
-    logits = qdot(last[:, 0, :], params["lm_head"]).astype(jnp.float32)
+    logits = _logits(config, params, last[:, 0, :])
     return logits, cache
 
 
@@ -394,18 +497,21 @@ def decode_step(
     P = prefix.max_len
 
     positions = (prompt_len + step) * jnp.ones((B, 1), jnp.int32)
-    x = jnp.take(params["embed"], token[:, None], axis=0)
+    x = _embed(config, params, token[:, None])
 
     # Self (generated) keys: slots 0..step inclusive are valid after the write.
     self_mask = (jnp.arange(G)[None, None, :] <= step) & jnp.ones((B, 1, 1), bool)
     # Prefix keys: positions < prompt_len are valid.
     prefix_mask = (jnp.arange(P)[None, None, :] < prompt_len) & jnp.ones((1, 1, 1), bool)
+    self_mask_global = prefix_mask_global = None
     if config.sliding_window is not None:
         # Query position is prompt_len + step; key position k is visible iff
         # q_pos - k_pos < W. Gen slot s sits at position prompt_len + s.
         W = config.sliding_window
-        self_mask &= jnp.arange(G)[None, None, :] > step - W
-        prefix_mask &= jnp.arange(P)[None, None, :] > prompt_len + step - W
+        if config.sliding_window_layers == "alternating":
+            self_mask_global, prefix_mask_global = self_mask, prefix_mask
+        self_mask = self_mask & (jnp.arange(G)[None, None, :] > step - W)
+        prefix_mask = prefix_mask & (jnp.arange(P)[None, None, :] > prompt_len + step - W)
 
     x, gen_cache = _apply_stack(
         config,
@@ -417,7 +523,9 @@ def decode_step(
         self_mask,
         prefix=prefix,
         prefix_mask=prefix_mask,
+        key_mask_global=self_mask_global,
+        prefix_mask_global=prefix_mask_global,
     )
-    h = rms_norm(x, params["final_norm"], config.rms_eps)
-    logits = qdot(h[:, 0, :], params["lm_head"]).astype(jnp.float32)
+    h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
+    logits = _logits(config, params, h[:, 0, :])
     return logits, gen_cache

@@ -71,17 +71,30 @@ def load_safetensors(path: str, config: ModelConfig, dtype=None) -> Dict[str, An
     # (rotate_half) convention our rope_embed uses, so q/k weights import
     # without re-permutation.
     L = config.num_layers
+    # Gemma-2 checkpoints name the PRE-MLP norm "pre_feedforward_layernorm" and
+    # reuse "post_attention_layernorm" for the post-norm on attention output;
+    # Llama-family checkpoints use "post_attention_layernorm" as the pre-MLP norm.
+    mlp_norm_key = (
+        "pre_feedforward_layernorm" if config.post_block_norms else "post_attention_layernorm"
+    )
     layers = {
         "attn_norm": np.stack([np.asarray(tensors[_hf_key(i, "input_layernorm")]) for i in range(L)]),
         "wq": np.stack([t(_hf_key(i, "self_attn.q_proj")) for i in range(L)]),
         "wk": np.stack([t(_hf_key(i, "self_attn.k_proj")) for i in range(L)]),
         "wv": np.stack([t(_hf_key(i, "self_attn.v_proj")) for i in range(L)]),
         "wo": np.stack([t(_hf_key(i, "self_attn.o_proj")) for i in range(L)]),
-        "mlp_norm": np.stack([np.asarray(tensors[_hf_key(i, "post_attention_layernorm")]) for i in range(L)]),
+        "mlp_norm": np.stack([np.asarray(tensors[_hf_key(i, mlp_norm_key)]) for i in range(L)]),
         "w_gate": np.stack([t(_hf_key(i, "mlp.gate_proj")) for i in range(L)]),
         "w_up": np.stack([t(_hf_key(i, "mlp.up_proj")) for i in range(L)]),
         "w_down": np.stack([t(_hf_key(i, "mlp.down_proj")) for i in range(L)]),
     }
+    if config.post_block_norms:  # Gemma-2
+        layers["post_attn_norm"] = np.stack(
+            [np.asarray(tensors[_hf_key(i, "post_attention_layernorm")]) for i in range(L)]
+        )
+        layers["post_mlp_norm"] = np.stack(
+            [np.asarray(tensors[_hf_key(i, "post_feedforward_layernorm")]) for i in range(L)]
+        )
 
     if config.qkv_bias:  # Qwen2 family
         for ours, hf_name in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
@@ -129,9 +142,21 @@ def config_from_hf(path: str) -> Optional[ModelConfig]:
     sliding_window = hf.get("sliding_window")
     if model_type == "qwen2" and not hf.get("use_sliding_window", False):
         sliding_window = None
+    gemma2 = model_type == "gemma2"
+    query_scale = None
+    if hf.get("query_pre_attn_scalar"):
+        query_scale = float(hf["query_pre_attn_scalar"]) ** -0.5
     return ModelConfig(
         qkv_bias=model_type == "qwen2" or hf.get("attention_bias", False),
         sliding_window=sliding_window,
+        sliding_window_layers="alternating" if gemma2 else "all",
+        act="gelu" if gemma2 else "silu",
+        norm_offset=gemma2,
+        embed_scale=gemma2,
+        post_block_norms=gemma2,
+        attn_softcap=hf.get("attn_logit_softcapping"),
+        logit_softcap=hf.get("final_logit_softcapping"),
+        query_scale=query_scale,
         name=os.path.basename(os.path.normpath(path)),
         vocab_size=hf["vocab_size"],
         hidden_size=hidden,

@@ -36,6 +36,9 @@ def param_specs(config: ModelConfig) -> Dict[str, Any]:
         layers["bq"] = P(None, MODEL_AXIS)
         layers["bk"] = P(None, MODEL_AXIS)
         layers["bv"] = P(None, MODEL_AXIS)
+    if config.post_block_norms:
+        layers["post_attn_norm"] = P(None, None)
+        layers["post_mlp_norm"] = P(None, None)
     return {
         "embed": P(MODEL_AXIS, None),  # vocab-sharded
         "layers": layers,

@@ -53,6 +53,19 @@ def test_qwen_decode_matches_forward():
     )
     np.testing.assert_allclose(pl_logits[0], full[0, 7], rtol=1e-5, atol=1e-5)
 
+    # Step-by-step decode must carry the biases through the cached path too.
+    n = 2
+    gen_cache = init_cache(TINY_QWEN, n, 4)
+    for step in range(3):
+        tk = jnp.broadcast_to(tokens[0, 8 + step], (n,))
+        logits, gen_cache = decode_step(
+            TINY_QWEN, params, tk, jnp.int32(step), prompt_len, gen_cache, prefix
+        )
+        full_s, _ = forward(
+            TINY_QWEN, params, tokens, (jnp.arange(S)[None, :] < 9 + step).astype(jnp.int32)
+        )
+        np.testing.assert_allclose(logits[0], full_s[0, 8 + step], rtol=1e-4, atol=1e-4)
+
 
 def test_sliding_window_equals_dense_when_window_covers_seq():
     cfg_wide = get_config("tiny").with_(sliding_window=64)
