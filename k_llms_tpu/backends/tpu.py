@@ -191,14 +191,15 @@ class TpuBackend(Backend):
         # Real token-level crop per the Backend contract. embeddings() slices
         # at MAX_EMBEDDING_TOKENS anyway (its own callers pass raw strings), so
         # already-cropped client inputs just pass through the slice unchanged.
-        # Fast path bound is the UTF-8 BYTE count: every tokenizer here emits
-        # at most one token per byte (byte tokenizer exactly; BPE merges), so
-        # byte-length <= cap guarantees token-length <= cap. Character count
-        # would not ("é"*100 is 100 chars but 200 byte-tokens).
+        # Fast path bound is the UTF-8 BYTE count: tokenizers here emit at most
+        # one token per byte (byte tokenizer exactly; BPE merges) PLUS up to one
+        # dummy-prefix token for SentencePiece, so byte-length < cap guarantees
+        # token-length <= cap. Character count would not ("é"*100 is 100 chars
+        # but 200 byte-tokens).
         tok = self.tokenizer
         return [
             t
-            if len(t.encode("utf-8")) <= max_tokens
+            if len(t.encode("utf-8")) < max_tokens
             else tok.decode(tok.encode(t)[:max_tokens])
             for t in texts
         ]

@@ -56,6 +56,10 @@ class ModelConfig:
     attn_softcap: "float | None" = None
     logit_softcap: "float | None" = None
     query_scale: "float | None" = None
+    # Mixture-of-experts (Mixtral family): every MLP becomes num_experts
+    # experts with top-k token-choice routing. 0 = dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
     # byte tokenizer vocab fits any vocab_size >= 260; HF tokenizers use the full space
     bos_token_id: int = 256
     eos_token_id: int = 257
@@ -240,6 +244,29 @@ register_config(
         bos_token_id=151643,
         eos_token_id=151645,
         pad_token_id=151643,
+    )
+)
+
+# Mixtral family: Mistral attention + 8-expert top-2 MoE MLPs. Experts shard
+# over the "model" mesh axis (expert parallelism).
+register_config(
+    ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        rms_eps=1e-5,
+        max_seq_len=8192,
+        num_experts=8,
+        num_experts_per_tok=2,
+        bos_token_id=1,
+        eos_token_id=2,
+        pad_token_id=2,
     )
 )
 

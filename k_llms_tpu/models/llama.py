@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .config import ModelConfig
-from .quant import qdot
+from .quant import qdot, qeinsum
 
 Params = Dict[str, Any]
 
@@ -67,7 +67,7 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
     def normal(k, shape, scale):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
     # Offset norms (Gemma) store w with effective scale (1 + w): identity is 0.
     norm_init = jnp.zeros if config.norm_offset else jnp.ones
     layers = {
@@ -77,10 +77,17 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
         "wv": normal(ks[2], (L, H, KV), 1.0 / math.sqrt(H)),
         "wo": normal(ks[3], (L, Q, H), 1.0 / math.sqrt(Q)),
         "mlp_norm": norm_init((L, H), dtype),
-        "w_gate": normal(ks[4], (L, H, I), 1.0 / math.sqrt(H)),
-        "w_up": normal(ks[5], (L, H, I), 1.0 / math.sqrt(H)),
-        "w_down": normal(ks[6], (L, I, H), 1.0 / math.sqrt(I)),
     }
+    if config.num_experts > 0:  # Mixtral family: per-expert MLP + router
+        E = config.num_experts
+        layers["w_router"] = normal(ks[7], (L, H, E), 1.0 / math.sqrt(H))
+        layers["w_gate"] = normal(ks[4], (L, E, H, I), 1.0 / math.sqrt(H))
+        layers["w_up"] = normal(ks[5], (L, E, H, I), 1.0 / math.sqrt(H))
+        layers["w_down"] = normal(ks[6], (L, E, I, H), 1.0 / math.sqrt(I))
+    else:
+        layers["w_gate"] = normal(ks[4], (L, H, I), 1.0 / math.sqrt(H))
+        layers["w_up"] = normal(ks[5], (L, H, I), 1.0 / math.sqrt(H))
+        layers["w_down"] = normal(ks[6], (L, I, H), 1.0 / math.sqrt(I))
     if config.qkv_bias:  # Qwen2 family
         layers["bq"] = jnp.zeros((L, Q), dtype)
         layers["bk"] = jnp.zeros((L, KV), dtype)
@@ -117,6 +124,26 @@ def _activation(config: ModelConfig, x: jax.Array) -> jax.Array:
     if config.act == "gelu":  # GeGLU (Gemma): tanh-approximate gelu
         return jax.nn.gelu(x, approximate=True)
     return jax.nn.silu(x)
+
+
+def _moe_mlp(config: ModelConfig, layer: Params, h: jax.Array) -> jax.Array:
+    """Mixtral top-k token-choice MoE, computed densely over the stacked expert
+    weights — one einsum per projection, no ragged gather/scatter, so XLA tiles
+    it straight onto the MXU and GSPMD turns the expert axis sharding into
+    expert parallelism. Router softmax is over the selected top-k only
+    (Mixtral semantics), scattered back to a [B,S,E] combine weight."""
+    E, K = config.num_experts, config.num_experts_per_tok
+    router_logits = (h @ layer["w_router"]).astype(jnp.float32)  # [B,S,E]
+    top_vals, top_idx = lax.top_k(router_logits, K)
+    top_w = jax.nn.softmax(top_vals, axis=-1)  # [B,S,K]
+    combine = (jax.nn.one_hot(top_idx, E, dtype=jnp.float32) * top_w[..., None]).sum(
+        axis=-2
+    )  # [B,S,E]
+
+    gate = _activation(config, qeinsum("bsh,ehi->bsei", h, layer["w_gate"]))
+    up = qeinsum("bsh,ehi->bsei", h, layer["w_up"])
+    expert_out = qeinsum("bsei,eih->bseh", gate * up, layer["w_down"])
+    return jnp.einsum("bseh,bse->bsh", expert_out, combine.astype(expert_out.dtype))
 
 
 def rope_embed(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -222,9 +249,12 @@ def _block(
 
     def mlp(x: jax.Array) -> jax.Array:
         h = rms_norm(x, layer["mlp_norm"], config.rms_eps, offset)
-        gate = _activation(config, qdot(h, layer["w_gate"]))
-        up = qdot(h, layer["w_up"])
-        out = qdot(gate * up, layer["w_down"])
+        if "w_router" in layer:  # MoE (Mixtral)
+            out = _moe_mlp(config, layer, h)
+        else:
+            gate = _activation(config, qdot(h, layer["w_gate"]))
+            up = qdot(h, layer["w_up"])
+            out = qdot(gate * up, layer["w_down"])
         if "post_mlp_norm" in layer:
             out = rms_norm(out, layer["post_mlp_norm"], config.rms_eps, offset)
         return x + out
@@ -317,10 +347,7 @@ def _apply_stack(
 
     def body(carry, scanned):
         x = carry
-        layer_params, layer_kv, layer_prefix, flag = scanned
-        prefix_kv = None
-        if layer_prefix is not None:
-            prefix_kv = (layer_prefix[0], layer_prefix[1])
+        flag = scanned.get("flag")
         if flag is None:
             km, pm = key_mask, prefix_mask
         else:
@@ -332,46 +359,27 @@ def _apply_stack(
             )
         x, new_kv = _block(
             config,
-            layer_params,
+            scanned["layers"],
             x,
             positions,
-            (layer_kv[0], layer_kv[1]),
+            scanned["kv"],
             write_index,
             km,
-            prefix_kv=prefix_kv,
+            prefix_kv=scanned.get("prefix"),
             prefix_mask=pm,
             key_lengths=key_lengths,
         )
         return x, new_kv
 
-    layers = params["layers"]
-    kv_stacked = (cache.k, cache.v)
-    prefix_stacked = (prefix.k, prefix.v) if prefix is not None else None
-
-    # lax.scan needs every scanned leaf to exist; encode the optional slots
-    # statically by building the xs tuple (and matching unpack) per case.
-    if prefix_stacked is None and local_flags is None:
-        x, new_kv = lax.scan(
-            lambda c, s: body(c, (s[0], s[1], None, None)), x, (layers, kv_stacked)
-        )
-    elif prefix_stacked is None:
-        x, new_kv = lax.scan(
-            lambda c, s: body(c, (s[0], s[1], None, s[2])),
-            x,
-            (layers, kv_stacked, local_flags),
-        )
-    elif local_flags is None:
-        x, new_kv = lax.scan(
-            lambda c, s: body(c, (s[0], s[1], s[2], None)),
-            x,
-            (layers, kv_stacked, prefix_stacked),
-        )
-    else:
-        x, new_kv = lax.scan(
-            lambda c, s: body(c, (s[0], s[1], s[2], s[3])),
-            x,
-            (layers, kv_stacked, prefix_stacked, local_flags),
-        )
+    # Optional scanned slots (shared prefix, per-layer window flags) are
+    # present-or-absent dict keys — one scan covers every combination with a
+    # statically known pytree structure.
+    xs = {"layers": params["layers"], "kv": (cache.k, cache.v)}
+    if prefix is not None:
+        xs["prefix"] = (prefix.k, prefix.v)
+    if local_flags is not None:
+        xs["flag"] = local_flags
+    x, new_kv = lax.scan(body, x, xs)
 
     return x, KVCache(k=new_kv[0], v=new_kv[1])
 

@@ -84,10 +84,30 @@ def load_safetensors(path: str, config: ModelConfig, dtype=None) -> Dict[str, An
         "wv": np.stack([t(_hf_key(i, "self_attn.v_proj")) for i in range(L)]),
         "wo": np.stack([t(_hf_key(i, "self_attn.o_proj")) for i in range(L)]),
         "mlp_norm": np.stack([np.asarray(tensors[_hf_key(i, mlp_norm_key)]) for i in range(L)]),
-        "w_gate": np.stack([t(_hf_key(i, "mlp.gate_proj")) for i in range(L)]),
-        "w_up": np.stack([t(_hf_key(i, "mlp.up_proj")) for i in range(L)]),
-        "w_down": np.stack([t(_hf_key(i, "mlp.down_proj")) for i in range(L)]),
     }
+    if config.num_experts > 0:
+        # Mixtral: block_sparse_moe.gate = router [E, H]; experts.{e}.w1/w3/w2
+        # are gate/up/down. Stack experts then layers: [L, E, in, out].
+        E = config.num_experts
+        layers["w_router"] = np.stack(
+            [t(f"model.layers.{i}.block_sparse_moe.gate.weight") for i in range(L)]
+        )
+        for ours, hf in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+            layers[ours] = np.stack(
+                [
+                    np.stack(
+                        [
+                            t(f"model.layers.{i}.block_sparse_moe.experts.{e}.{hf}.weight")
+                            for e in range(E)
+                        ]
+                    )
+                    for i in range(L)
+                ]
+            )
+    else:
+        layers["w_gate"] = np.stack([t(_hf_key(i, "mlp.gate_proj")) for i in range(L)])
+        layers["w_up"] = np.stack([t(_hf_key(i, "mlp.up_proj")) for i in range(L)])
+        layers["w_down"] = np.stack([t(_hf_key(i, "mlp.down_proj")) for i in range(L)])
     if config.post_block_norms:  # Gemma-2
         layers["post_attn_norm"] = np.stack(
             [np.asarray(tensors[_hf_key(i, "post_attention_layernorm")]) for i in range(L)]
@@ -149,6 +169,8 @@ def config_from_hf(path: str) -> Optional[ModelConfig]:
     return ModelConfig(
         qkv_bias=model_type == "qwen2" or hf.get("attention_bias", False),
         sliding_window=sliding_window,
+        num_experts=hf.get("num_local_experts", 0),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         sliding_window_layers="alternating" if gemma2 else "all",
         act="gelu" if gemma2 else "silu",
         norm_offset=gemma2,

@@ -67,6 +67,17 @@ def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
     return x @ w
 
 
+def qeinsum(spec: str, x: jax.Array, w: WeightLike) -> jax.Array:
+    """``einsum(spec, x, w)`` for a plain array or QTensor weight. Requires the
+    output's trailing axes to line up with the weight's non-contracted axes
+    (true for the MoE expert einsums: "bsh,ehi->bsei", "bsei,eih->bseh"), so
+    the squeezed per-channel scale broadcasts onto the output."""
+    if isinstance(w, QTensor):
+        out = jnp.einsum(spec, x, w.q.astype(x.dtype))
+        return out * w.scale[..., 0, :].astype(out.dtype)
+    return jnp.einsum(spec, x, w)
+
+
 def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize the seven block matmuls and lm_head; leave embed/norms as-is."""
     layers = dict(params["layers"])

@@ -31,6 +31,14 @@ def param_specs(config: ModelConfig) -> Dict[str, Any]:
         "w_up": P(None, None, MODEL_AXIS),
         "w_down": P(None, MODEL_AXIS, None),
     }
+    if config.num_experts > 0:
+        # Expert parallelism: the expert axis of [L, E, H, I] weights shards
+        # over "model"; each device computes its experts, GSPMD reduces the
+        # combine. The router replicates.
+        layers["w_router"] = P(None, None, None)
+        layers["w_gate"] = P(None, MODEL_AXIS, None, None)
+        layers["w_up"] = P(None, MODEL_AXIS, None, None)
+        layers["w_down"] = P(None, MODEL_AXIS, None, None)
     if config.qkv_bias:
         # Biases follow their projection's output-feature sharding.
         layers["bq"] = P(None, MODEL_AXIS)
