@@ -181,22 +181,26 @@ def _gqa_scores_shared(q: jax.Array, k: jax.Array) -> jax.Array:
 
 
 def _gqa_values(weights: jax.Array, v: jax.Array) -> jax.Array:
-    """weights: [B, QH, Sq, Sk], v: [B, Sk, KVH, D] -> [B, Sq, QH, D]."""
+    """weights: [B, QH, Sq, Sk], v: [B, Sk, KVH, D] -> [B, Sq, QH, D] f32.
+
+    V stays in its cache dtype (bf16) with f32 MXU accumulation — an explicit
+    astype(f32) here would materialize a double-width copy of the whole cache
+    every decode step (HBM traffic is the decode bottleneck)."""
     B, QH, Sq, Sk = weights.shape
     KVH = v.shape[2]
     G = QH // KVH
-    wg = weights.reshape(B, KVH, G, Sq, Sk)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v.astype(jnp.float32))
+    wg = weights.astype(v.dtype).reshape(B, KVH, G, Sq, Sk)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v, preferred_element_type=jnp.float32)
     return out.reshape(B, Sq, QH, v.shape[3])
 
 
 def _gqa_values_shared(weights: jax.Array, v: jax.Array) -> jax.Array:
-    """weights: [B, QH, Sq, Sk], shared v: [1, Sk, KVH, D] -> [B, Sq, QH, D]."""
+    """weights: [B, QH, Sq, Sk], shared v: [1, Sk, KVH, D] -> [B, Sq, QH, D] f32."""
     B, QH, Sq, Sk = weights.shape
     KVH = v.shape[2]
     G = QH // KVH
-    wg = weights.reshape(B, KVH, G, Sq, Sk)
-    out = jnp.einsum("bhgqk,khd->bqhgd", wg, v[0].astype(jnp.float32))
+    wg = weights.astype(v.dtype).reshape(B, KVH, G, Sq, Sk)
+    out = jnp.einsum("bhgqk,khd->bqhgd", wg, v[0], preferred_element_type=jnp.float32)
     return out.reshape(B, Sq, QH, v.shape[3])
 
 
