@@ -102,6 +102,13 @@ class TpuBackend(Backend):
 
         temperature = 1.0 if request.temperature is None else float(request.temperature)
         max_new = request.max_tokens or self.default_max_new_tokens
+        # Structured-output requests get grammar-constrained decoding: every
+        # sample is valid JSON by construction (the reference relies on the
+        # OpenAI server for this guarantee). Byte-level tokenizers only; BPE
+        # vocabs fall back to free generation + parse-time degradation.
+        constraint = None
+        if request.response_format is not None and getattr(tok, "is_byte_level", False):
+            constraint = "json"
         result = self.scheduler.call(
             lambda: self.engine.generate(
                 prompt_ids,
@@ -111,6 +118,7 @@ class TpuBackend(Backend):
                 top_p=request.top_p,
                 seed=request.seed,
                 eos_ids=tok.stop_ids,
+                constraint=constraint,
             )
         )
 

@@ -156,14 +156,20 @@ class LocalEngine:
         temperature: float,
         top_p: Optional[float],
         top_k: Optional[int],
+        constraint: Optional[str] = None,
     ):
-        cache_key = (n, max_new, temperature, top_p, top_k)
+        cache_key = (n, max_new, temperature, top_p, top_k, constraint)
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
             return fn
 
         config = self.config
         pad_id = config.pad_token_id
+
+        if constraint == "json":
+            from .json_constraint import advance, device_tables, initial_state, mask_logits
+
+            jt = device_tables()
 
         def _loop(params, prefix: KVCache, prompt_len, first_logits, key, eos_ids):
             gen_cache = init_cache(config, n, max_new)
@@ -176,10 +182,19 @@ class LocalEngine:
                 sample_logits, temperature=temperature, top_p=top_p, top_k=top_k
             )
 
+            if constraint == "json":
+                jstate = initial_state(n)
+            else:
+                jstate = None
+
             # First token: the shared prefill logits, n independent draws.
             logits0 = jnp.broadcast_to(first_logits[0], (n, first_logits.shape[-1]))
+            if jstate is not None:
+                logits0 = mask_logits(jt, logits0, *jstate, eos_ids)
             tok0, lp0 = sample(logits0, jax.random.fold_in(key, 0))
             tok0 = self._constraint(tok0, batch_spec())
+            if jstate is not None:
+                jstate = advance(jt, tok0, *jstate)
             done0 = jnp.isin(tok0, eos_ids)
 
             tokens_buf = jnp.full((n, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
@@ -190,21 +205,25 @@ class LocalEngine:
                 return jnp.logical_and(step < max_new - 1, jnp.logical_not(jnp.all(done)))
 
             def body(state):
-                step, cur, done, cache, toks, lps = state
+                step, cur, done, cache, toks, lps, jst = state
                 logits, cache = decode_step(
                     config, params, cur, step, prompt_len, cache, prefix
                 )
+                if jst is not None:
+                    logits = mask_logits(jt, logits, *jst, eos_ids)
                 nxt, lp = sample(logits, jax.random.fold_in(key, step + 1))
                 nxt = jnp.where(done, pad_id, nxt).astype(jnp.int32)
                 nxt = self._constraint(nxt, batch_spec())
+                if jst is not None:
+                    jst = advance(jt, nxt, *jst)  # pad/eos (>=256) freeze the row
                 lp = jnp.where(done, 0.0, lp)
                 toks = lax.dynamic_update_slice(toks, nxt[:, None], (0, step + 1))
                 lps = lax.dynamic_update_slice(lps, lp[:, None], (0, step + 1))
                 done = jnp.logical_or(done, jnp.isin(nxt, eos_ids))
-                return (step + 1, nxt, done, cache, toks, lps)
+                return (step + 1, nxt, done, cache, toks, lps, jst)
 
-            state = (jnp.int32(0), tok0, done0, gen_cache, tokens_buf, logprob_buf)
-            step, cur, done, cache, toks, lps = lax.while_loop(cond, body, state)
+            state = (jnp.int32(0), tok0, done0, gen_cache, tokens_buf, logprob_buf, jstate)
+            step, cur, done, cache, toks, lps, _ = lax.while_loop(cond, body, state)
             return toks, lps, done
 
         fn = jax.jit(_loop)
@@ -222,6 +241,7 @@ class LocalEngine:
         top_k: Optional[int] = None,
         seed: Optional[int] = None,
         eos_ids: Optional[Sequence[int]] = None,
+        constraint: Optional[str] = None,
     ) -> GenerationResult:
         config = self.config
         prompt_ids = list(prompt_ids)
@@ -245,6 +265,20 @@ class LocalEngine:
         eos = list(eos_ids or [config.eos_token_id])[:MAX_EOS_IDS]
         eos_arr = jnp.array(eos + [-1] * (MAX_EOS_IDS - len(eos)), jnp.int32)
 
+        # Validate before any device work (prefill compiles take seconds).
+        if constraint is not None and constraint != "json":
+            raise ValueError(f"Unknown constraint {constraint!r}; supported: 'json'")
+        if constraint == "json":
+            # The mask treats token ids 0..255 AS bytes — the caller must use a
+            # byte-level tokenizer (TpuBackend gates on tokenizer.is_byte_level).
+            # Specials (eos/pad) must live above the byte range, or the eos
+            # column would alias onto a byte and corrupt the automaton.
+            if config.vocab_size <= 256 or any(e < 256 for e in eos):
+                raise ValueError(
+                    "constraint='json' needs byte-level token semantics: vocab > 256 "
+                    "with eos/pad ids outside the 0..255 byte range"
+                )
+
         tokens = jnp.array(
             [prompt_ids + [config.pad_token_id] * (bucket - prompt_len)], jnp.int32
         )
@@ -255,7 +289,9 @@ class LocalEngine:
         first_logits, prefix = self._get_prefill(bucket)(
             self.params, tokens, jnp.int32(prompt_len)
         )
-        loop = self._get_decode_loop(n_padded, max_new_tokens, temperature, top_p, top_k)
+        loop = self._get_decode_loop(
+            n_padded, max_new_tokens, temperature, top_p, top_k, constraint
+        )
         toks, lps, done = loop(
             self.params, prefix, jnp.int32(prompt_len), first_logits, key, eos_arr
         )

@@ -1,0 +1,350 @@
+"""Constrained JSON decoding: a byte-level JSON pushdown automaton compiled to
+dense transition tables that run inside the jitted decode loop as a logit mask.
+
+The reference delegates structured output to the OpenAI API, which enforces
+JSON server-side (`/root/reference/k_llms/resources/completions/completions.py:134`);
+a local engine must enforce it during sampling or `parse()` degrades to
+best-effort text. With the byte tokenizer (token == byte) the JSON grammar is a
+character-level automaton: finite states for the scalar/string/number lexing,
+plus a bounded stack for object/array nesting carried through the
+``lax.while_loop``. Per step:
+
+  mask  = ALLOWED[state] (+ stack-dependent closers + depth guard)  -> logits
+  state = TRANS[state, emitted_byte] (sentinels resolve via the stack)
+
+Everything data-dependent is a table lookup — no Python control flow in the
+compiled program.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+# --- states ---------------------------------------------------------------
+_NAMES = [
+    "VALUE",       # expect the start of a value
+    "OBJ_OPEN",    # just after '{': key string or '}'
+    "ARR_OPEN",    # just after '[': value or ']'
+    "KEY",         # inside a key string
+    "KEY_ESC",
+    "KEY_U1", "KEY_U2", "KEY_U3", "KEY_U4",
+    "AFTER_KEY",   # expect ':'
+    "STR",         # inside a value string
+    "STR_ESC",
+    "STR_U1", "STR_U2", "STR_U3", "STR_U4",
+    "NUM_MINUS",
+    "NUM_ZERO",    # strict JSON: a leading 0 takes no further digits
+    "NUM_INT",
+    "NUM_DOT",
+    "NUM_FRAC",
+    "NUM_E",
+    "NUM_ESIGN",
+    "NUM_EXP",
+    "T1", "T2", "T3",            # 'rue' of true
+    "F1", "F2", "F3", "F4",      # 'alse' of false
+    "N1", "N2", "N3",            # 'ull' of null
+    "AFTER_VALUE",  # a value just completed
+    "KEY_START",    # after ',' inside an object: expect '"'
+    "DONE",         # top-level value complete: whitespace only
+]
+S = {name: i for i, name in enumerate(_NAMES)}
+NUM_STATES = len(_NAMES)
+
+# Sentinel next-states, resolved against the stack at runtime.
+SENT_COMMA = NUM_STATES       # ',' after a value: object -> KEY_START, array -> VALUE
+SENT_CLOSE = NUM_STATES + 1   # '}' / ']': pop; empty stack -> DONE else AFTER_VALUE
+
+# Stack ops.
+OP_NONE, OP_PUSH_OBJ, OP_PUSH_ARR, OP_POP = 0, 1, 2, 3
+CTX_OBJ, CTX_ARR = 1, 2
+
+_WS = [0x20, 0x09, 0x0A, 0x0D]
+_DIGITS = list(range(0x30, 0x3A))
+# States from which the enclosing container may be closed by '}' / ']'.
+_CLOSABLE = ["NUM_ZERO", "NUM_INT", "NUM_FRAC", "NUM_EXP", "AFTER_VALUE"]
+# States where a top-level document may legally end (EOS permitted at depth 0).
+_TERMINAL = ["NUM_ZERO", "NUM_INT", "NUM_FRAC", "NUM_EXP", "AFTER_VALUE", "DONE"]
+
+
+class JsonTables(NamedTuple):
+    trans: np.ndarray     # [S, 256] int16 next state, sentinel, or -1 (invalid)
+    stackop: np.ndarray   # [S, 256] int8 OP_*
+    allowed: np.ndarray   # [S, 256] bool (= trans >= 0)
+    closable: np.ndarray  # [S] bool: '}'/']' here close the enclosing container
+    terminal: np.ndarray  # [S] bool: EOS legal here when depth == 0
+
+
+def _value_starts(trans, stackop, state: int) -> None:
+    """Wire the start-of-value transitions out of ``state``."""
+    trans[state, ord("{")] = S["OBJ_OPEN"]
+    stackop[state, ord("{")] = OP_PUSH_OBJ
+    trans[state, ord("[")] = S["ARR_OPEN"]
+    stackop[state, ord("[")] = OP_PUSH_ARR
+    trans[state, ord('"')] = S["STR"]
+    trans[state, ord("-")] = S["NUM_MINUS"]
+    trans[state, ord("0")] = S["NUM_ZERO"]
+    for d in _DIGITS[1:]:
+        trans[state, d] = S["NUM_INT"]
+    trans[state, ord("t")] = S["T1"]
+    trans[state, ord("f")] = S["F1"]
+    trans[state, ord("n")] = S["N1"]
+
+
+def _string_body(trans, state: str, esc: str, u1: str) -> None:
+    """In-string transitions: any byte except '"', '\\', and control chars."""
+    for b in range(0x20, 0x100):
+        trans[S[state], b] = S[state]
+    trans[S[state], ord('"')] = -1  # set by caller (key vs value differ)
+    trans[S[state], ord("\\")] = S[esc]
+    for b in b'"\\/bfnrt':
+        trans[S[esc], b] = S[state]
+    trans[S[esc], ord("u")] = S[u1]
+    hex_bytes = b"0123456789abcdefABCDEF"
+    names = [u1, u1[:-1] + str(int(u1[-1]) + 1), u1[:-1] + str(int(u1[-1]) + 2), u1[:-1] + str(int(u1[-1]) + 3)]
+    for i in range(4):
+        nxt = S[state] if i == 3 else S[names[i + 1]]
+        for b in hex_bytes:
+            trans[S[names[i]], b] = nxt
+
+
+def _end_of_value(trans, stackop, state: int) -> None:
+    """A value can be followed by ws, ',', or a closer."""
+    for w in _WS:
+        trans[state, w] = S["AFTER_VALUE"]
+    trans[state, ord(",")] = SENT_COMMA
+    trans[state, ord("}")] = SENT_CLOSE
+    stackop[state, ord("}")] = OP_POP
+    trans[state, ord("]")] = SENT_CLOSE
+    stackop[state, ord("]")] = OP_POP
+
+
+@lru_cache(maxsize=1)
+def build_tables() -> JsonTables:
+    trans = np.full((NUM_STATES, 256), -1, np.int16)
+    stackop = np.zeros((NUM_STATES, 256), np.int8)
+
+    for w in _WS:  # whitespace self-loops where structure permits
+        for st in ("VALUE", "OBJ_OPEN", "ARR_OPEN", "AFTER_KEY", "AFTER_VALUE", "KEY_START", "DONE"):
+            trans[S[st], w] = S[st]
+
+    _value_starts(trans, stackop, S["VALUE"])
+    _value_starts(trans, stackop, S["ARR_OPEN"])
+    trans[S["ARR_OPEN"], ord("]")] = SENT_CLOSE
+    stackop[S["ARR_OPEN"], ord("]")] = OP_POP
+
+    # Object: key string then ':' then value.
+    trans[S["OBJ_OPEN"], ord('"')] = S["KEY"]
+    trans[S["OBJ_OPEN"], ord("}")] = SENT_CLOSE
+    stackop[S["OBJ_OPEN"], ord("}")] = OP_POP
+    trans[S["KEY_START"], ord('"')] = S["KEY"]
+
+    _string_body(trans, "KEY", "KEY_ESC", "KEY_U1")
+    trans[S["KEY"], ord('"')] = S["AFTER_KEY"]
+    trans[S["AFTER_KEY"], ord(":")] = S["VALUE"]
+
+    _string_body(trans, "STR", "STR_ESC", "STR_U1")
+    trans[S["STR"], ord('"')] = S["AFTER_VALUE"]
+
+    # Numbers (terminable mid-lex on delimiter/ws). Strict JSON: '0' takes no
+    # further digits (leading zeros are invalid); '-' needs 0 or 1-9.
+    trans[S["NUM_MINUS"], ord("0")] = S["NUM_ZERO"]
+    for d in _DIGITS[1:]:
+        trans[S["NUM_MINUS"], d] = S["NUM_INT"]
+    for d in _DIGITS:
+        trans[S["NUM_INT"], d] = S["NUM_INT"]
+        trans[S["NUM_DOT"], d] = S["NUM_FRAC"]
+        trans[S["NUM_FRAC"], d] = S["NUM_FRAC"]
+        trans[S["NUM_ESIGN"], d] = S["NUM_EXP"]
+        trans[S["NUM_EXP"], d] = S["NUM_EXP"]
+    for st in ("NUM_ZERO", "NUM_INT"):
+        trans[S[st], ord(".")] = S["NUM_DOT"]
+        for e in b"eE":
+            trans[S[st], e] = S["NUM_E"]
+    for e in b"eE":
+        trans[S["NUM_FRAC"], e] = S["NUM_E"]
+    for sgn in b"+-":
+        trans[S["NUM_E"], sgn] = S["NUM_ESIGN"]
+    for d in _DIGITS:
+        trans[S["NUM_E"], d] = S["NUM_EXP"]
+    for st in ("NUM_ZERO", "NUM_INT", "NUM_FRAC", "NUM_EXP"):
+        _end_of_value(trans, stackop, S[st])
+
+    # Literals.
+    for chain, bytes_ in (("T", b"rue"), ("F", b"alse"), ("N", b"ull")):
+        steps = [f"{chain}{i+1}" for i in range(len(bytes_))]
+        for i, b in enumerate(bytes_):
+            nxt = S["AFTER_VALUE"] if i == len(bytes_) - 1 else S[steps[i + 1]]
+            trans[S[steps[i]], b] = nxt
+
+    # Also wires the ws self-loop: _end_of_value maps ws -> AFTER_VALUE.
+    _end_of_value(trans, stackop, S["AFTER_VALUE"])
+
+    closable = np.zeros(NUM_STATES, bool)
+    for st in _CLOSABLE:
+        closable[S[st]] = True
+    closable[S["OBJ_OPEN"]] = True  # '{}'
+    closable[S["ARR_OPEN"]] = True  # '[]'
+    terminal = np.zeros(NUM_STATES, bool)
+    for st in _TERMINAL:
+        terminal[S[st]] = True
+
+    return JsonTables(
+        trans=trans,
+        stackop=stackop,
+        allowed=trans >= 0,
+        closable=closable,
+        terminal=terminal,
+    )
+
+
+# --- host-side validator (tests + non-jit callers) ------------------------
+
+def validate_prefix(data: bytes, max_depth: int = 16) -> Tuple[bool, bool]:
+    """Run the automaton over ``data``. Returns (is_valid_prefix, is_complete).
+    The same tables the device uses — a differential oracle for the mask."""
+    t = build_tables()
+    state, depth = S["VALUE"], 0
+    stack = [0] * max_depth
+    for byte in data:
+        nxt = int(t.trans[state, byte])
+        if nxt < 0:
+            return False, False
+        if nxt == SENT_COMMA and depth == 0:
+            return False, False  # ',' outside any container
+        op = int(t.stackop[state, byte])
+        if op == OP_PUSH_OBJ or op == OP_PUSH_ARR:
+            if depth >= max_depth:
+                return False, False
+            stack[depth] = CTX_OBJ if op == OP_PUSH_OBJ else CTX_ARR
+            depth += 1
+        elif op == OP_POP:
+            want = CTX_OBJ if byte == ord("}") else CTX_ARR
+            if depth == 0 or stack[depth - 1] != want:
+                return False, False
+            depth -= 1
+        if nxt == SENT_COMMA:
+            state = S["KEY_START"] if (depth and stack[depth - 1] == CTX_OBJ) else S["VALUE"]
+        elif nxt == SENT_CLOSE:
+            state = S["DONE"] if depth == 0 else S["AFTER_VALUE"]
+        else:
+            state = nxt
+    return True, bool(t.terminal[state]) and depth == 0
+
+
+# --- device side (jit-compatible) -----------------------------------------
+
+class DeviceTables(NamedTuple):
+    trans: "object"     # [S, 256] i32 (device)
+    stackop: "object"   # [S, 256] i32
+    allowed: "object"   # [S, 256] bool
+    closable: "object"  # [S] bool
+    terminal: "object"  # [S] bool
+
+
+@lru_cache(maxsize=1)
+def device_tables() -> DeviceTables:
+    import jax.numpy as jnp
+
+    t = build_tables()
+    return DeviceTables(
+        trans=jnp.asarray(t.trans, jnp.int32),
+        stackop=jnp.asarray(t.stackop, jnp.int32),
+        allowed=jnp.asarray(t.allowed),
+        closable=jnp.asarray(t.closable),
+        terminal=jnp.asarray(t.terminal),
+    )
+
+
+def initial_state(n: int, max_depth: int = 16):
+    """(state [n], depth [n], stack [n, max_depth]) before any byte."""
+    import jax.numpy as jnp
+
+    return (
+        jnp.full((n,), S["VALUE"], jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n, max_depth), jnp.int32),
+    )
+
+
+def mask_logits(t: DeviceTables, logits, state, depth, stack, eos_arr):
+    """Apply the JSON mask to [n, V] logits. Byte columns 0..255 follow the
+    automaton; EOS columns open only when the document is complete; everything
+    else (other special tokens) is banned."""
+    import jax.numpy as jnp
+
+    n, V = logits.shape
+    max_depth = stack.shape[1]
+    base = t.allowed[state]  # [n, 256]
+
+    top = jnp.take_along_axis(
+        stack, jnp.maximum(depth - 1, 0)[:, None], axis=1
+    )[:, 0]
+    has = depth > 0
+    obj_ok = has & (top == CTX_OBJ)
+    arr_ok = has & (top == CTX_ARR)
+    cols = jnp.arange(256)
+    # The stack-top check applies only where '}'/']' would actually POP — in
+    # string states they are ordinary content bytes and stay unrestricted.
+    pop_brace = t.stackop[state, ord("}")] == OP_POP  # [n]
+    pop_brack = t.stackop[state, ord("]")] == OP_POP
+    bad_brace = pop_brace & ~obj_ok
+    bad_brack = pop_brack & ~arr_ok
+    base = base & ~((cols[None, :] == ord("}")) & bad_brace[:, None])
+    base = base & ~((cols[None, :] == ord("]")) & bad_brack[:, None])
+    # ',' only continues a CONTAINER: at depth 0 there is nothing to separate.
+    comma_trans = t.trans[state, ord(",")] == SENT_COMMA
+    bad_comma = comma_trans & ~has
+    base = base & ~((cols[None, :] == ord(",")) & bad_comma[:, None])
+    # Depth guard: no further nesting at the stack limit. Gated on the byte
+    # actually PUSHING (inside strings '{'/'[' are plain content bytes).
+    full = depth >= max_depth
+    push_brace = t.stackop[state, ord("{")] == OP_PUSH_OBJ
+    push_brack = t.stackop[state, ord("[")] == OP_PUSH_ARR
+    base = base & ~((cols[None, :] == ord("{")) & (push_brace & full)[:, None])
+    base = base & ~((cols[None, :] == ord("[")) & (push_brack & full)[:, None])
+
+    mask = jnp.zeros((n, V), bool)
+    mask = mask.at[:, :256].set(base[:, : min(256, V)])
+    eos_ok = t.terminal[state] & (depth == 0)  # [n]
+    valid_eos = eos_arr >= 0
+    mask = mask.at[:, jnp.clip(eos_arr, 0, V - 1)].max(
+        eos_ok[:, None] & valid_eos[None, :]
+    )
+    return jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+
+
+def advance(t: DeviceTables, token, state, depth, stack):
+    """Step the automaton with the emitted token ([n] int32). Tokens >= 256
+    (EOS/pad) freeze the row. Returns (state, depth, stack)."""
+    import jax.numpy as jnp
+
+    max_depth = stack.shape[1]
+    is_byte = token < 256
+    byte = jnp.clip(token, 0, 255)
+    nxt = t.trans[state, byte]
+    op = t.stackop[state, byte]
+
+    push = (op == OP_PUSH_OBJ) | (op == OP_PUSH_ARR)
+    ctx = jnp.where(op == OP_PUSH_OBJ, CTX_OBJ, CTX_ARR)
+    slot = jnp.arange(max_depth)[None, :] == depth[:, None]
+    stack = jnp.where(slot & (push & is_byte)[:, None], ctx[:, None], stack)
+    new_depth = depth + jnp.where(is_byte, push.astype(jnp.int32) - (op == OP_POP), 0)
+
+    # Sentinels resolve against the stack AFTER the op.
+    top = jnp.take_along_axis(stack, jnp.maximum(new_depth - 1, 0)[:, None], axis=1)[:, 0]
+    in_obj = (new_depth > 0) & (top == CTX_OBJ)
+    nxt = jnp.where(
+        nxt == SENT_COMMA,
+        jnp.where(in_obj, S["KEY_START"], S["VALUE"]),
+        nxt,
+    )
+    nxt = jnp.where(
+        nxt == SENT_CLOSE,
+        jnp.where(new_depth == 0, S["DONE"], S["AFTER_VALUE"]),
+        nxt,
+    )
+    state = jnp.where(is_byte, nxt, state)
+    return state, jnp.where(is_byte, new_depth, depth), stack

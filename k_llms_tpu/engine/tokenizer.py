@@ -19,6 +19,7 @@ class ByteTokenizer:
     """UTF-8 bytes + special tokens. ids 0..255 = bytes; 256=bos, 257=eos/eot, 258=pad."""
 
     vocab_size = 512  # headroom so models can round vocab up for MXU tiling
+    is_byte_level = True  # token id == byte value: grammar constraints apply
 
     bos_id = 256
     eos_id = 257
@@ -52,6 +53,8 @@ class ByteTokenizer:
 
 class HFTokenizer:
     """transformers tokenizer from a local directory (e.g. a Llama-3 checkpoint)."""
+
+    is_byte_level = False  # BPE merges: byte-level grammar masks don't apply
 
     def __init__(self, path: str):
         if not os.path.isdir(path):
