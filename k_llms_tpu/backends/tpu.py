@@ -11,6 +11,7 @@ routes to the local model. Zero OpenAI calls (BASELINE.md target).
 from __future__ import annotations
 
 import hashlib
+import logging
 import time
 from typing import Any, Dict, List, Optional
 
@@ -26,6 +27,8 @@ from .base import Backend, ChatRequest
 
 # Embedding inputs crop at the same token cap as the reference (`client.py:12`).
 MAX_EMBEDDING_TOKENS = 8191
+
+logger = logging.getLogger(__name__)
 
 
 class BackendConfig(BaseModel):
@@ -93,6 +96,7 @@ class TpuBackend(Backend):
         from ..engine.scheduler import EngineScheduler
 
         self.scheduler = EngineScheduler(name=self.model_name)
+        self._dfa_cache: Dict[str, Any] = {}
 
     # -- chat -------------------------------------------------------------
     def chat_completion(self, request: ChatRequest) -> ChatCompletion:
@@ -102,13 +106,13 @@ class TpuBackend(Backend):
 
         temperature = 1.0 if request.temperature is None else float(request.temperature)
         max_new = request.max_tokens or self.default_max_new_tokens
-        # Structured-output requests get grammar-constrained decoding: every
-        # sample is valid JSON by construction (the reference relies on the
-        # OpenAI server for this guarantee). Byte-level tokenizers only; BPE
-        # vocabs fall back to free generation + parse-time degradation.
-        constraint = None
-        if request.response_format is not None and getattr(tok, "is_byte_level", False):
-            constraint = "json"
+        # Structured-output requests get grammar-constrained decoding (the
+        # reference relies on the OpenAI server for this guarantee). A pydantic
+        # response_format compiles to a schema DFA — keys, types, and enums
+        # enforced, so every sample validates into the user's model; anything
+        # the compiler can't express falls back to the valid-JSON automaton.
+        # Byte-level tokenizers only; BPE vocabs free-generate.
+        constraint = self._constraint_for(request.response_format)
         result = self.scheduler.call(
             lambda: self.engine.generate(
                 prompt_ids,
@@ -184,6 +188,31 @@ class TpuBackend(Backend):
                 },
             }
         )
+
+    def _constraint_for(self, response_format: Any):
+        if response_format is None or not getattr(self.tokenizer, "is_byte_level", False):
+            return None
+        schema = None
+        if isinstance(response_format, type) and hasattr(response_format, "model_json_schema"):
+            schema = response_format.model_json_schema()
+        elif isinstance(response_format, dict):
+            # OpenAI wire form: {"type": "json_schema", "json_schema": {"schema": ...}}
+            schema = (response_format.get("json_schema") or {}).get("schema")
+        if schema is not None:
+            digest = repr(sorted(schema.items(), key=lambda kv: kv[0]))[:4096]
+            cached = self._dfa_cache.get(digest)
+            if cached is not None:
+                return cached if cached != "json" else "json"
+            from ..engine.schema_constraint import SchemaUnsupported, compile_schema
+
+            try:
+                dfa = compile_schema(schema)
+                self._dfa_cache[digest] = dfa
+                return dfa
+            except SchemaUnsupported as e:
+                logger.info("schema DFA unsupported (%s); using generic JSON mask", e)
+                self._dfa_cache[digest] = "json"
+        return "json"
 
     # -- embeddings -------------------------------------------------------
     def embeddings(self, texts: List[str]) -> List[List[float]]:

@@ -158,7 +158,10 @@ class LocalEngine:
         top_k: Optional[int],
         constraint: Optional[str] = None,
     ):
-        cache_key = (n, max_new, temperature, top_p, top_k, constraint)
+        constraint_key = constraint
+        if constraint is not None and constraint != "json":
+            constraint_key = ("schema", constraint.digest)
+        cache_key = (n, max_new, temperature, top_p, top_k, constraint_key)
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -170,6 +173,19 @@ class LocalEngine:
             from .json_constraint import advance, device_tables, initial_state, mask_logits
 
             jt = device_tables()
+        elif constraint is not None:  # a compiled SchemaDFA
+            from .schema_constraint import (
+                device_dfa,
+                dfa_advance,
+                dfa_initial_state,
+                dfa_mask_logits,
+            )
+
+            jt = device_dfa(constraint)
+            # Same call shapes as the json automaton: state is a 1-tuple.
+            initial_state = lambda n: (dfa_initial_state(jt, n),)  # noqa: E731
+            mask_logits = dfa_mask_logits
+            advance = lambda t, tok, state: (dfa_advance(t, tok, state),)  # noqa: E731
 
         def _loop(params, prefix: KVCache, prompt_len, first_logits, key, eos_ids):
             gen_cache = init_cache(config, n, max_new)
@@ -182,10 +198,7 @@ class LocalEngine:
                 sample_logits, temperature=temperature, top_p=top_p, top_k=top_k
             )
 
-            if constraint == "json":
-                jstate = initial_state(n)
-            else:
-                jstate = None
+            jstate = initial_state(n) if constraint is not None else None
 
             # First token: the shared prefill logits, n independent draws.
             logits0 = jnp.broadcast_to(first_logits[0], (n, first_logits.shape[-1]))
@@ -266,16 +279,20 @@ class LocalEngine:
         eos_arr = jnp.array(eos + [-1] * (MAX_EOS_IDS - len(eos)), jnp.int32)
 
         # Validate before any device work (prefill compiles take seconds).
-        if constraint is not None and constraint != "json":
-            raise ValueError(f"Unknown constraint {constraint!r}; supported: 'json'")
-        if constraint == "json":
-            # The mask treats token ids 0..255 AS bytes — the caller must use a
+        from .schema_constraint import SchemaDFA
+
+        if constraint is not None and constraint != "json" and not isinstance(constraint, SchemaDFA):
+            raise ValueError(
+                f"Unknown constraint {constraint!r}; supported: 'json' or a compiled SchemaDFA"
+            )
+        if constraint is not None:
+            # The masks treat token ids 0..255 AS bytes — the caller must use a
             # byte-level tokenizer (TpuBackend gates on tokenizer.is_byte_level).
             # Specials (eos/pad) must live above the byte range, or the eos
             # column would alias onto a byte and corrupt the automaton.
             if config.vocab_size <= 256 or any(e < 256 for e in eos):
                 raise ValueError(
-                    "constraint='json' needs byte-level token semantics: vocab > 256 "
+                    "grammar constraints need byte-level token semantics: vocab > 256 "
                     "with eos/pad ids outside the 0..255 byte range"
                 )
 

@@ -31,10 +31,14 @@ _NAMES = [
     "KEY",         # inside a key string
     "KEY_ESC",
     "KEY_U1", "KEY_U2", "KEY_U3", "KEY_U4",
+    "KEY_C1", "KEY_C2", "KEY_C3",          # UTF-8: pending continuation bytes
+    "KEY_E0", "KEY_ED", "KEY_F0", "KEY_F4",  # UTF-8: restricted second byte
     "AFTER_KEY",   # expect ':'
     "STR",         # inside a value string
     "STR_ESC",
     "STR_U1", "STR_U2", "STR_U3", "STR_U4",
+    "STR_C1", "STR_C2", "STR_C3",
+    "STR_E0", "STR_ED", "STR_F0", "STR_F4",
     "NUM_MINUS",
     "NUM_ZERO",    # strict JSON: a leading 0 takes no further digits
     "NUM_INT",
@@ -94,11 +98,38 @@ def _value_starts(trans, stackop, state: int) -> None:
 
 
 def _string_body(trans, state: str, esc: str, u1: str) -> None:
-    """In-string transitions: any byte except '"', '\\', and control chars."""
-    for b in range(0x20, 0x100):
+    """In-string transitions: ASCII content, escapes, and WELL-FORMED UTF-8
+    multibyte sequences (JSON must be valid UTF-8; a stray continuation byte
+    would make the emitted document unparseable)."""
+    p = state  # "KEY" or "STR": prefixes the UTF-8 helper states
+    for b in range(0x20, 0x80):
         trans[S[state], b] = S[state]
     trans[S[state], ord('"')] = -1  # set by caller (key vs value differ)
     trans[S[state], ord("\\")] = S[esc]
+    # UTF-8 lead bytes out of the body state.
+    for b in range(0xC2, 0xE0):
+        trans[S[state], b] = S[f"{p}_C1"]
+    trans[S[state], 0xE0] = S[f"{p}_E0"]
+    for b in [*range(0xE1, 0xED), 0xEE, 0xEF]:
+        trans[S[state], b] = S[f"{p}_C2"]
+    trans[S[state], 0xED] = S[f"{p}_ED"]
+    trans[S[state], 0xF0] = S[f"{p}_F0"]
+    for b in range(0xF1, 0xF4):
+        trans[S[state], b] = S[f"{p}_C3"]
+    trans[S[state], 0xF4] = S[f"{p}_F4"]
+    # Continuation chains.
+    for b in range(0x80, 0xC0):
+        trans[S[f"{p}_C1"], b] = S[state]
+        trans[S[f"{p}_C2"], b] = S[f"{p}_C1"]
+        trans[S[f"{p}_C3"], b] = S[f"{p}_C2"]
+    for b in range(0xA0, 0xC0):
+        trans[S[f"{p}_E0"], b] = S[f"{p}_C1"]
+    for b in range(0x80, 0xA0):
+        trans[S[f"{p}_ED"], b] = S[f"{p}_C1"]
+    for b in range(0x90, 0xC0):
+        trans[S[f"{p}_F0"], b] = S[f"{p}_C2"]
+    for b in range(0x80, 0x90):
+        trans[S[f"{p}_F4"], b] = S[f"{p}_C2"]
     for b in b'"\\/bfnrt':
         trans[S[esc], b] = S[state]
     trans[S[esc], ord("u")] = S[u1]

@@ -1,0 +1,376 @@
+"""Schema-guided decoding: compile a JSON Schema (pydantic
+``model_json_schema()``) into a byte-level DFA enforced as a logit mask.
+
+Where ``json_constraint`` guarantees syntactic JSON, this guarantees the
+SCHEMA: object keys in order, value types, enum literals, array structure —
+so every sample of a ``parse()`` request validates into the user's pydantic
+model (the guarantee the reference delegates to OpenAI's structured outputs,
+`/root/reference/k_llms/resources/completions/completions.py:134`).
+
+Because object keys are literal text, the compiled automaton needs no stack:
+nesting unrolls into the state chain at compile time. Each schema compiles to
+dense ``trans[S, 256]`` tables (a few hundred states for typical extraction
+schemas); the decode loop indexes them exactly like the generic JSON tables.
+
+Supported: objects (nested, all properties emitted in schema order), string,
+integer, number, boolean, null, Optional/anyOf unions with distinct first
+bytes, string enums (compiled to a shared-prefix trie), arrays of any
+supported element, and const. Unsupported constructs raise
+``SchemaUnsupported`` — the caller falls back to the generic JSON automaton.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+_DIGITS = list(range(0x30, 0x3A))
+
+
+class SchemaUnsupported(Exception):
+    """Schema uses a construct the DFA compiler does not cover."""
+
+
+class SchemaDFA(NamedTuple):
+    trans: np.ndarray    # [S, 256] int32 next state or -1
+    terminal: np.ndarray  # [S] bool — EOS permitted here
+    start: int
+    digest: str          # cache key for jit reuse
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.trans: List[Dict[int, int]] = []
+
+    def new_state(self) -> int:
+        self.trans.append({})
+        return len(self.trans) - 1
+
+    def edge(self, src: int, byte: int, dst: int) -> None:
+        existing = self.trans[src].get(byte)
+        if existing is not None and existing != dst:
+            raise SchemaUnsupported(
+                f"ambiguous transition on byte {byte!r} (union arms must start "
+                "with distinct bytes)"
+            )
+        self.trans[src][byte] = dst
+
+    def literal(self, src: int, data: bytes) -> int:
+        """Chain of single-byte states consuming ``data``; returns the end state."""
+        cur = src
+        for b in data:
+            nxt = self.new_state()
+            self.edge(cur, b, nxt)
+            cur = nxt
+        return cur
+
+    # -- value builders: each wires src -> (accepting) end state ----------
+
+    def string_body(self, src: int) -> int:
+        """Content of a string AFTER the opening quote, through the closing
+        quote. Escapes and \\uXXXX supported; control bytes excluded; multibyte
+        sequences are constrained to WELL-FORMED UTF-8 (JSON documents must be
+        valid UTF-8, and json.loads rejects stray continuation bytes)."""
+        body = self.new_state()
+        esc = self.new_state()
+        end = self.new_state()
+        c1 = self.new_state()  # expect 1 continuation byte
+        c2 = self.new_state()  # expect 2
+        c3 = self.new_state()  # expect 3
+        e0 = self.new_state()  # E0: next in A0..BF
+        ed = self.new_state()  # ED: next in 80..9F (no surrogates)
+        f0 = self.new_state()  # F0: next in 90..BF
+        f4 = self.new_state()  # F4: next in 80..8F (<= U+10FFFF)
+        for state in (src, body):
+            for b in range(0x20, 0x80):
+                if b not in (0x22, 0x5C):  # '"' and '\\'
+                    self.edge(state, b, body)
+            self.edge(state, 0x22, end)
+            self.edge(state, 0x5C, esc)
+            for b in range(0xC2, 0xE0):
+                self.edge(state, b, c1)
+            self.edge(state, 0xE0, e0)
+            for b in [*range(0xE1, 0xED), 0xEE, 0xEF]:
+                self.edge(state, b, c2)
+            self.edge(state, 0xED, ed)
+            self.edge(state, 0xF0, f0)
+            for b in range(0xF1, 0xF4):
+                self.edge(state, b, c3)
+            self.edge(state, 0xF4, f4)
+        for b in range(0x80, 0xC0):
+            self.edge(c1, b, body)
+            self.edge(c2, b, c1)
+            self.edge(c3, b, c2)
+        for b in range(0xA0, 0xC0):
+            self.edge(e0, b, c1)
+        for b in range(0x80, 0xA0):
+            self.edge(ed, b, c1)
+        for b in range(0x90, 0xC0):
+            self.edge(f0, b, c2)
+        for b in range(0x80, 0x90):
+            self.edge(f4, b, c2)
+        for b in b'"\\/bfnrt':
+            self.edge(esc, b, body)
+        u = [self.new_state() for _ in range(4)]
+        self.edge(esc, ord("u"), u[0])
+        for i in range(4):
+            nxt = body if i == 3 else u[i + 1]
+            for b in b"0123456789abcdefABCDEF":
+                self.edge(u[i], b, nxt)
+        return end
+
+    def string(self, src: int) -> int:
+        quote = self.new_state()
+        self.edge(src, 0x22, quote)
+        return self.string_body(quote)
+
+    def number(self, src: int, integer_only: bool = False) -> int:
+        """JSON number; the end state is the ACCEPTING state reached only once
+        at least one digit exists. Digits self-loop on the end state."""
+        end = self.new_state()       # >=1 int digit seen (accepting)
+        zero = self.new_state()      # leading 0: no more int digits
+        minus = self.new_state()
+        self.edge(src, ord("-"), minus)
+        for s in (src, minus):
+            self.edge(s, ord("0"), zero)
+            for d in _DIGITS[1:]:
+                self.edge(s, d, end)
+        for d in _DIGITS:
+            self.edge(end, d, end)
+        terminals = [end, zero]
+        if not integer_only:
+            dot = self.new_state()
+            frac = self.new_state()
+            e = self.new_state()
+            esign = self.new_state()
+            exp = self.new_state()
+            for s in (end, zero):
+                self.edge(s, ord("."), dot)
+                for eb in b"eE":
+                    self.edge(s, eb, e)
+            for d in _DIGITS:
+                self.edge(dot, d, frac)
+                self.edge(frac, d, frac)
+                self.edge(e, d, exp)
+                self.edge(esign, d, exp)
+                self.edge(exp, d, exp)
+            for eb in b"eE":
+                self.edge(frac, eb, e)
+            for sgn in b"+-":
+                self.edge(e, sgn, esign)
+            terminals += [frac, exp]
+        # Merge the number's accepting states into ONE end by epsilon-free
+        # convention: callers continue from a fresh state reachable from every
+        # terminal on the FOLLOW byte — instead we return a list; see follow().
+        self._num_terminals = terminals
+        return terminals  # type: ignore[return-value]
+
+    def value(self, src: int, schema: dict, defs: dict) -> List[int]:
+        """Wire a schema value from ``src``; returns accepting state(s)."""
+        schema = self.resolve(schema, defs)
+        if "const" in schema:
+            return [self.literal(src, json.dumps(schema["const"]).encode())]
+        if "enum" in schema:
+            return self.trie(src, [json.dumps(v).encode() for v in schema["enum"]])
+        if "anyOf" in schema or "oneOf" in schema:
+            arms = schema.get("anyOf") or schema.get("oneOf")
+            ends: List[int] = []
+            for arm in arms:
+                ends.extend(self.value(src, arm, defs))
+            return ends
+        t = schema.get("type")
+        if isinstance(t, list):
+            ends = []
+            for tt in t:
+                ends.extend(self.value(src, {**schema, "type": tt}, defs))
+            return ends
+        if t == "string":
+            return [self.string(src)]
+        if t == "integer":
+            return self.number(src, integer_only=True)  # type: ignore[return-value]
+        if t == "number":
+            return self.number(src)  # type: ignore[return-value]
+        if t == "boolean":
+            return [self.literal(src, b"true"), self.literal(src, b"false")]
+        if t == "null":
+            return [self.literal(src, b"null")]
+        if t == "object":
+            return [self.object(src, schema, defs)]
+        if t == "array":
+            return [self.array(src, schema, defs)]
+        raise SchemaUnsupported(f"unsupported schema node: {schema!r}")
+
+    def object(self, src: int, schema: dict, defs: dict) -> int:
+        props = schema.get("properties")
+        if not props:
+            raise SchemaUnsupported("object without properties (free-form)")
+        if schema.get("additionalProperties") not in (False, None):
+            raise SchemaUnsupported("additionalProperties")
+        cur = self.literal(src, b"{")
+        for i, (name, sub) in enumerate(props.items()):
+            prefix = (b"," if i else b"") + json.dumps(name).encode() + b":"
+            cur = self.literal(cur, prefix)
+            ends = self.value(cur, sub, defs)
+            cur = self.follow(ends)
+        return self.close(cur, b"}")
+
+    def array(self, src: int, schema: dict, defs: dict) -> int:
+        items = schema.get("items")
+        if not items:
+            raise SchemaUnsupported("array without items schema")
+        open_ = self.literal(src, b"[")
+        end = self.new_state()
+        self.edge(open_, ord("]"), end)  # empty array
+        elem_ends = self.value(open_, items, defs)
+        again = self.new_state()
+        for e in elem_ends:
+            self.edge(e, ord(","), again)
+            self.edge(e, ord("]"), end)
+        more_ends = self.value(again, items, defs)
+        for e in more_ends:
+            self.edge(e, ord(","), again)
+            self.edge(e, ord("]"), end)
+        return end
+
+    def trie(self, src: int, literals: List[bytes]) -> List[int]:
+        """Shared-prefix trie over literal alternatives (string enums)."""
+        ends: List[int] = []
+        by_state: Dict[Tuple[int, int], int] = {}
+        for lit in literals:
+            cur = src
+            for i, b in enumerate(lit):
+                nxt = self.trans[cur].get(b)
+                if nxt is None:
+                    nxt = self.new_state()
+                    self.edge(cur, b, nxt)
+                cur = nxt
+            ends.append(cur)
+        return ends
+
+    def follow(self, ends: List[int]) -> int:
+        """Merge multiple accepting states: later edges added to the merged
+        state are mirrored onto every end (numbers terminate lazily, so the
+        next literal byte decides where the value stopped)."""
+        if len(ends) == 1:
+            return ends[0]
+        merged = self.new_state()
+        self._merges.setdefault(merged, []).extend(ends)
+        return merged
+
+    def close(self, cur: int, lit: bytes) -> int:
+        return self.literal(cur, lit)
+
+    def resolve(self, schema: dict, defs: dict) -> dict:
+        seen = 0
+        while "$ref" in schema:
+            ref = schema["$ref"]
+            if not ref.startswith("#/$defs/"):
+                raise SchemaUnsupported(f"unsupported $ref {ref!r}")
+            schema = defs[ref.split("/")[-1]]
+            seen += 1
+            if seen > 16:
+                raise SchemaUnsupported("recursive $ref")
+        return schema
+
+    _merges: Dict[int, List[int]] = {}
+
+
+def compile_schema(schema: dict) -> SchemaDFA:
+    """Compile a JSON Schema dict (pydantic ``model_json_schema()``) to a DFA.
+    Raises :class:`SchemaUnsupported` for constructs outside the subset."""
+    b = _Builder()
+    b._merges = {}
+    defs = schema.get("$defs", {})
+    start = b.new_state()
+    ends = b.value(start, schema, defs)
+
+    # Propagate merged-state edges back onto their sources (see follow()).
+    # Iterate to a fixed point: merged states may chain.
+    changed = True
+    while changed:
+        changed = False
+        for merged, sources in b._merges.items():
+            for byte, dst in list(b.trans[merged].items()):
+                for s in sources:
+                    if b.trans[s].get(byte) is None:
+                        b.trans[s][byte] = dst
+                        changed = True
+
+    n = len(b.trans)
+    trans = np.full((n, 256), -1, np.int32)
+    for s, edges in enumerate(b.trans):
+        for byte, dst in edges.items():
+            trans[s, byte] = dst
+    terminal = np.zeros(n, bool)
+    for e in ends:
+        terminal[e] = True
+        for src_list in ([b._merges[e]] if e in b._merges else []):
+            for s in src_list:
+                terminal[s] = True
+
+    digest = hashlib.sha256(
+        json.dumps(schema, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+    return SchemaDFA(trans=trans, terminal=terminal, start=start, digest=digest)
+
+
+def validate_bytes(dfa: SchemaDFA, data: bytes) -> Tuple[bool, bool]:
+    """(valid_prefix, complete) — host-side oracle mirroring the device mask."""
+    state = dfa.start
+    for byte in data:
+        nxt = int(dfa.trans[state, byte])
+        if nxt < 0:
+            return False, False
+        state = nxt
+    return True, bool(dfa.terminal[state])
+
+
+# --- device side (jit-compatible) -----------------------------------------
+
+class DeviceDFA(NamedTuple):
+    trans: "object"     # [S, 256] i32 (device)
+    allowed: "object"   # [S, 256] bool
+    terminal: "object"  # [S] bool
+    start: int
+    digest: str
+
+
+def device_dfa(dfa: SchemaDFA) -> DeviceDFA:
+    import jax.numpy as jnp
+
+    return DeviceDFA(
+        trans=jnp.asarray(dfa.trans),
+        allowed=jnp.asarray(dfa.trans >= 0),
+        terminal=jnp.asarray(dfa.terminal),
+        start=dfa.start,
+        digest=dfa.digest,
+    )
+
+
+def dfa_initial_state(d: DeviceDFA, n: int):
+    import jax.numpy as jnp
+
+    return jnp.full((n,), d.start, jnp.int32)
+
+
+def dfa_mask_logits(d: DeviceDFA, logits, state, eos_arr):
+    import jax.numpy as jnp
+
+    n, V = logits.shape
+    mask = jnp.zeros((n, V), bool)
+    mask = mask.at[:, :256].set(d.allowed[state][:, : min(256, V)])
+    eos_ok = d.terminal[state]
+    valid_eos = eos_arr >= 0
+    mask = mask.at[:, jnp.clip(eos_arr, 0, V - 1)].max(eos_ok[:, None] & valid_eos[None, :])
+    return jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+
+
+def dfa_advance(d: DeviceDFA, token, state):
+    import jax.numpy as jnp
+
+    is_byte = token < 256
+    nxt = d.trans[state, jnp.clip(token, 0, 255)]
+    return jnp.where(is_byte, nxt, state)
