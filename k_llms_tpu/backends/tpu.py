@@ -193,16 +193,26 @@ class TpuBackend(Backend):
         if response_format is None or not getattr(self.tokenizer, "is_byte_level", False):
             return None
         schema = None
+        wants_json = False
         if isinstance(response_format, type) and hasattr(response_format, "model_json_schema"):
             schema = response_format.model_json_schema()
         elif isinstance(response_format, dict):
-            # OpenAI wire form: {"type": "json_schema", "json_schema": {"schema": ...}}
-            schema = (response_format.get("json_schema") or {}).get("schema")
+            kind = response_format.get("type")
+            if kind == "json_object":
+                wants_json = True
+            elif kind == "json_schema":
+                # OpenAI wire form: {"type": "json_schema", "json_schema": {"schema": ...}}
+                schema = (response_format.get("json_schema") or {}).get("schema")
+                wants_json = True  # schema-less json_schema payload degrades to JSON mask
         if schema is not None:
-            digest = repr(sorted(schema.items(), key=lambda kv: kv[0]))[:4096]
+            import json
+
+            digest = hashlib.sha256(
+                json.dumps(schema, sort_keys=True, default=str).encode()
+            ).hexdigest()
             cached = self._dfa_cache.get(digest)
             if cached is not None:
-                return cached if cached != "json" else "json"
+                return cached
             from ..engine.schema_constraint import SchemaUnsupported, compile_schema
 
             try:
@@ -212,7 +222,10 @@ class TpuBackend(Backend):
             except SchemaUnsupported as e:
                 logger.info("schema DFA unsupported (%s); using generic JSON mask", e)
                 self._dfa_cache[digest] = "json"
-        return "json"
+                return "json"
+        # {"type": "text"} and unrecognized forms are unconstrained — only an
+        # explicit JSON request earns the grammar mask.
+        return "json" if wants_json else None
 
     # -- embeddings -------------------------------------------------------
     def embeddings(self, texts: List[str]) -> List[List[float]]:

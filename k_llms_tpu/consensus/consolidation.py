@@ -254,7 +254,9 @@ def consolidate_parsed_chat_completions(
     assert len(completion.choices) > 0, "Cannot consolidate empty list of choices"
 
     if len(completion.choices) == 1:
-        return KLLMsParsedChatCompletion.model_validate(completion.model_dump())
+        result = KLLMsParsedChatCompletion.model_validate(completion.model_dump())
+        _fill_parsed(result.choices, response_format)
+        return result
 
     parsed_choice_contents: List[Dict[str, Any]] = []
     used_mask: List[bool] = []
@@ -313,7 +315,31 @@ def consolidate_parsed_chat_completions(
     # ParsedChatCompletion generics re-validate; our vendored generic stores Any).
     if parsed_consensus is not None:
         result.choices[0].message.parsed = parsed_consensus
+    _fill_parsed(result.choices[1:], response_format)
     return result
+
+
+def _fill_parsed(choices, response_format: Optional[Type[BaseModel]]) -> None:
+    """Validate raw sample text into ``response_format`` in place.
+
+    The reference's originals arrive server-parsed (completions.py:134); our
+    local backend emits plain text, so the parse happens here — same
+    silent-None degradation as the consensus choice.
+    """
+    if not (
+        response_format
+        and isinstance(response_format, type)
+        and issubclass(response_format, BaseModel)
+    ):
+        return
+    for choice in choices:
+        if choice.message.parsed is None and choice.message.content:
+            try:
+                choice.message.parsed = response_format.model_validate(
+                    _safe_parse_content(choice.message.content)
+                )
+            except Exception:
+                pass
 
 
 async def async_consolidate_chat_completions(

@@ -10,6 +10,12 @@ is O(S/P) and context scales with the ring size.
 Used for prefilling prompts too long for one device's HBM; the resulting KV
 cache is already sequence-sharded for subsequent ring decode, or can be
 gathered for the dense shared-prefix decode path.
+
+The per-position math (projections, biases, activations, norms, MoE routing,
+quantized weights) is the same code the dense path uses — only attention is
+swapped for the ring kernel — so every model family the dense ``_block``
+supports works here unchanged, except score-level features the ring kernel
+cannot express (attention softcap, sliding windows), which raise.
 """
 
 from __future__ import annotations
@@ -23,7 +29,15 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.llama import rms_norm, rope_embed
+from ..models.llama import (
+    _activation,
+    _embed,
+    _logits,
+    _moe_mlp,
+    rms_norm,
+    rope_embed,
+)
+from ..models.quant import qdot
 from ..ops.ring_attention import ring_attention
 
 
@@ -39,6 +53,11 @@ def forward_sequence_parallel(
     tokens: [B, S] with S divisible by the ring size. Returns (logits f32
     [B, S, V], final hidden [B, S, H]), both sequence-sharded.
     """
+    if config.attn_softcap is not None or config.sliding_window is not None:
+        raise NotImplementedError(
+            "ring attention cannot apply per-score softcap or sliding windows; "
+            f"config {config.name!r} must use the dense prefill path"
+        )
     B, S = tokens.shape
     ring = mesh.shape[seq_axis]
     if S % ring != 0:
@@ -49,14 +68,18 @@ def forward_sequence_parallel(
     def constrain(x):
         return lax.with_sharding_constraint(x, seq_sharded)
 
+    offset = config.norm_offset
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-    x = constrain(jnp.take(params["embed"], tokens, axis=0))
+    x = constrain(_embed(config, params, tokens))
 
     def body(x, layer):
-        h = rms_norm(x, layer["attn_norm"], config.rms_eps)
-        q = (h @ layer["wq"]).reshape(B, S, config.num_heads, config.head_dim)
-        k = (h @ layer["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
-        v = (h @ layer["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+        h = rms_norm(x, layer["attn_norm"], config.rms_eps, offset)
+        q, k, v = qdot(h, layer["wq"]), qdot(h, layer["wk"]), qdot(h, layer["wv"])
+        if "bq" in layer:  # Qwen2-family QKV biases
+            q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+        q = q.reshape(B, S, config.num_heads, config.head_dim)
+        k = k.reshape(B, S, config.num_kv_heads, config.head_dim)
+        v = v.reshape(B, S, config.num_kv_heads, config.head_dim)
         q = rope_embed(q, positions, config.rope_theta)
         k = rope_embed(k, positions, config.rope_theta)
 
@@ -67,17 +90,26 @@ def forward_sequence_parallel(
             v.transpose(0, 2, 1, 3),
             seq_axis=seq_axis,
             causal=True,
+            sm_scale=config.query_scale,
         ).transpose(0, 2, 1, 3)
         attn = attn.astype(x.dtype).reshape(B, S, config.q_dim)
-        x = constrain(x + attn @ layer["wo"])
+        out = qdot(attn, layer["wo"])
+        if "post_attn_norm" in layer:
+            out = rms_norm(out, layer["post_attn_norm"], config.rms_eps, offset)
+        x = constrain(x + out)
 
-        h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
-        gate = jax.nn.silu(h @ layer["w_gate"])
-        up = h @ layer["w_up"]
-        x = constrain(x + (gate * up) @ layer["w_down"])
+        h = rms_norm(x, layer["mlp_norm"], config.rms_eps, offset)
+        if "w_router" in layer:  # MoE (Mixtral)
+            out = _moe_mlp(config, layer, h)
+        else:
+            gate = _activation(config, qdot(h, layer["w_gate"]))
+            up = qdot(h, layer["w_up"])
+            out = qdot(gate * up, layer["w_down"])
+        if "post_mlp_norm" in layer:
+            out = rms_norm(out, layer["post_mlp_norm"], config.rms_eps, offset)
+        x = constrain(x + out)
         return x, None
 
     x, _ = lax.scan(body, x, params["layers"])
-    h = rms_norm(x, params["final_norm"], config.rms_eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)
-    return logits, h
+    h = rms_norm(x, params["final_norm"], config.rms_eps, offset)
+    return _logits(config, params, h), h

@@ -151,7 +151,7 @@ register_config(
 register_config(
     ModelConfig(
         name="gemma-2-2b",
-        vocab_size=256128,
+        vocab_size=256000,  # HF gemma-2 safetensors layout (not the 256128 padded Flax release)
         hidden_size=2304,
         intermediate_size=9216,
         num_layers=26,
@@ -179,7 +179,7 @@ register_config(
 register_config(
     ModelConfig(
         name="gemma-2-9b",
-        vocab_size=256128,
+        vocab_size=256000,  # HF gemma-2 safetensors layout (not the 256128 padded Flax release)
         hidden_size=3584,
         intermediate_size=14336,
         num_layers=42,

@@ -69,6 +69,29 @@ def test_parse_revalidates_into_model():
     assert resp.likelihoods["name"] == 0.75
 
 
+def test_parse_populates_originals_and_single_sample():
+    """Local samples are plain text, so parse() must fill ``parsed`` on the
+    originals (the reference gets this from the server, completions.py:134) —
+    including the n=1 single-choice passthrough."""
+
+    class UserInfo(BaseModel):
+        name: str
+        age: int
+
+    client = make_client([json.dumps({"name": "Bob", "age": 44})] * 4)
+    resp = client.chat.completions.parse(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3, response_format=UserInfo
+    )
+    for choice in resp.choices:
+        assert isinstance(choice.message.parsed, UserInfo)
+
+    resp1 = client.chat.completions.parse(
+        messages=[{"role": "user", "content": "q"}], model="m", n=1, response_format=UserInfo
+    )
+    assert isinstance(resp1.choices[0].message.parsed, UserInfo)
+    assert resp1.choices[0].message.parsed.name == "Bob"
+
+
 def test_parse_failure_gives_none_parsed():
     class Strict(BaseModel):
         count: int

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from k_llms_tpu.engine.long_context import forward_sequence_parallel
 from k_llms_tpu.models import get_config, init_params
@@ -28,6 +29,48 @@ def test_sequence_parallel_matches_dense():
     np.testing.assert_allclose(
         np.asarray(hidden_sp), np.asarray(hidden_ref), rtol=2e-4, atol=2e-4
     )
+
+
+VARIANTS = {
+    "qwen2-bias": dict(qkv_bias=True),
+    "gemma2-norms": dict(
+        act="gelu",
+        norm_offset=True,
+        embed_scale=True,
+        post_block_norms=True,
+        logit_softcap=30.0,
+        query_scale=0.125,
+    ),
+    "moe": dict(num_experts=4, num_experts_per_tok=2),
+}
+
+
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_sequence_parallel_matches_dense_variants(variant):
+    """Every architecture branch the dense _block supports (QKV bias, Gemma-2
+    norms/GeGLU/softcap, MoE routing) must agree between ring and dense paths."""
+    cfg = get_config("tiny").with_(**VARIANTS[variant])
+    params = init_params(cfg, jax.random.key(2))
+    mesh = make_mesh(8, 1)
+    B, S = 2, 32
+    tokens = jax.random.randint(jax.random.key(3), (B, S), 0, cfg.vocab_size)
+
+    logits_sp, _ = jax.jit(
+        lambda p, t: forward_sequence_parallel(cfg, p, t, mesh, seq_axis="data")
+    )(params, tokens)
+    logits_ref, _ = forward(cfg, params, tokens, jnp.ones((B, S), jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(logits_sp), np.asarray(logits_ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_sequence_parallel_rejects_softcap_and_window():
+    mesh = make_mesh(8, 1)
+    for over in (dict(attn_softcap=50.0), dict(sliding_window=16)):
+        cfg = get_config("tiny").with_(**over)
+        params = init_params(cfg, jax.random.key(0))
+        with pytest.raises(NotImplementedError):
+            forward_sequence_parallel(cfg, params, jnp.zeros((1, 64), jnp.int32), mesh)
 
 
 def test_sequence_parallel_rejects_indivisible():
