@@ -1,59 +1,246 @@
 """Structural list alignment: dynamic threshold, reference-list election,
 Hungarian assignment, support pruning.
 
-Parity targets in `/root/reference/k_llms/utils/consensus_utils.py`:
-``SimilarityCache`` :81-106, ``_prune_low_support_elements`` :109-149,
-``low_cutoff_bound``/``remove_outliers`` :152-182, ``_compute_dynamic_threshold``
-:185-252, ``_build_reference_list`` :255-333 (greedy similarity grouping with a
-one-element-per-source-list constraint and medoid re-election of the group
-representative), ``_align_lists_to_reference_hungarian`` :336-379, and the master
-``lists_alignment`` :382-430.
-
-The Hungarian solve goes through our native C++ (``k_llms_tpu.native``) instead of
-scipy; the similarity function is closed over a :class:`SimilarityScorer`.
+Behavioral spec (constants, tie-breaks, thresholds) follows
+`/root/reference/k_llms/utils/consensus_utils.py` :109-430 and is pinned by the
+differential oracle in ``tests/test_reference_parity.py``; the implementation
+here is its own design: every list element gets a row in a flat
+:class:`ElementTable` whose dense pairwise-similarity matrix is built once, and
+each pipeline stage (threshold estimation, group election, assignment, pruning)
+is a masked numpy computation over that matrix instead of nested dict-of-sets
+scanning. The Hungarian solve goes through our native C++
+(``k_llms_tpu.native``) instead of scipy.
 """
 
 from __future__ import annotations
 
 import logging
-from collections import defaultdict
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..native import linear_sum_assignment
 from .majority import _original_positions, sort_by_original_majority
-from .primitive import consensus_as_primitive
 from .settings import ConsensusSettings
-from .similarity import SimilarityScorer
 
 logger = logging.getLogger(__name__)
 
 Index = Tuple[int, int]  # (list_idx, element_idx)
 
+_BASE_THRESHOLD = 0.5
 
-class SimilarityCache:
-    """Symmetric memo of pairwise element similarities, keyed by index pairs."""
 
-    def __init__(self, sim_fn: Callable[[Any, Any], float], list_of_lists: List[List[Any]]):
-        self.sim_fn = sim_fn
-        self.cache: Dict[Tuple[Index, Index], float] = {}
-        self.list_of_lists = list_of_lists
+class ElementTable:
+    """Flat view of a list-of-lists with a dense similarity matrix.
+
+    Row ``r`` of the matrix corresponds to element ``self.element(r)``; the
+    matrix is symmetric and its diagonal is pinned to 1.0 — an element is
+    always a perfect match for itself, whatever the similarity function says.
+    The full pipeline touches nearly every pair, so the matrix fills eagerly
+    (the scorer's own TTL caches absorb repeats); with ``anchor_list`` set,
+    only that list's rows are computed — the known-reference alignment path
+    reads nothing else.
+    """
+
+    def __init__(
+        self,
+        sim_fn: Callable[[Any, Any], float],
+        lists: Sequence[Sequence[Any]],
+        anchor_list: Optional[int] = None,
+    ):
+        self.lists = [list(lst) for lst in lists]
+        self.owner = np.array(
+            [li for li, lst in enumerate(self.lists) for _ in lst], dtype=np.int64
+        )
+        self.slot = np.array(
+            [pos for lst in self.lists for pos in range(len(lst))], dtype=np.int64
+        )
+        flat = [x for lst in self.lists for x in lst]
+        self.flat = flat
+        # flat row id for a given (list_idx, element_idx)
+        self._starts = np.cumsum([0] + [len(lst) for lst in self.lists])
+
+        n = len(flat)
+        sim = np.ones((n, n))
+        if anchor_list is None:
+            for a in range(n):
+                for b in range(a + 1, n):
+                    sim[a, b] = sim[b, a] = sim_fn(flat[a], flat[b])
+        else:
+            for a in self.rows_of(anchor_list):
+                for b in range(n):
+                    if b != a:
+                        sim[a, b] = sim[b, a] = sim_fn(flat[a], flat[b])
+        self.sim = sim
+
+    def __len__(self) -> int:
+        return len(self.flat)
+
+    def row(self, index: Index) -> int:
+        return int(self._starts[index[0]] + index[1])
+
+    def element(self, r: int) -> Index:
+        return (int(self.owner[r]), int(self.slot[r]))
+
+    def rows_of(self, list_idx: int) -> np.ndarray:
+        return np.arange(self._starts[list_idx], self._starts[list_idx + 1])
 
     def get(self, a_idx: Index, b_idx: Index) -> float:
-        key = (a_idx, b_idx)
-        reverse_key = (b_idx, a_idx)
-        if key in self.cache:
-            return self.cache[key]
-        if reverse_key in self.cache:
-            return self.cache[reverse_key]
-        sim = self.sim_fn(
-            self.list_of_lists[a_idx[0]][a_idx[1]],
-            self.list_of_lists[b_idx[0]][b_idx[1]],
+        """Pair similarity by (list_idx, element_idx) — the old memo's API."""
+        return float(self.sim[self.row(a_idx), self.row(b_idx)])
+
+
+# Backwards-compatible alias: earlier revisions exposed the memo under this name.
+SimilarityCache = ElementTable
+
+
+def low_cutoff_bound(scores) -> float:
+    """Outlier cutoff: a significant 'jump' near the low end of sorted scores.
+
+    A gap among the bottom 20% of the sorted scores larger than 3x the median
+    bottom-gap marks everything below it as outlier; the cutoff lands just
+    above the gap (epsilon keeps the boundary value excluded).
+    """
+    ordered = np.sort(np.asarray(scores, dtype=float))
+    if ordered.size == 0:
+        return 0.0
+    gaps = np.diff(ordered[: int(0.2 * ordered.size)])
+    if gaps.size:
+        big = gaps > np.median(gaps) * 3
+        if big.any():
+            first = int(np.argmax(big))
+            return float(ordered[first + 1]) + 1e-4
+    return float(ordered[0])
+
+
+def remove_outliers(data: List[float]) -> List[float]:
+    bound = low_cutoff_bound(data)
+    return [x for x in data if x >= bound]
+
+
+def _best_match_scores(table: ElementTable) -> List[float]:
+    """Distribution of greedy best-match scores used for the dynamic threshold.
+
+    Scanning sources in order, each element claims its best still-unclaimed
+    partner from any LATER list, provided the similarity clears the 0.5 base;
+    claims reset per source list. Ties go to the lowest row id (earliest list,
+    earliest position) — np.argmax's first-hit rule matches the strict-greater
+    scan it replaces.
+    """
+    scores: List[float] = []
+    n_lists = len(table.lists)
+    for src in range(n_lists):
+        claimed = np.zeros(len(table), dtype=bool)
+        later = table.owner > src
+        for r in table.rows_of(src):
+            pool = later & ~claimed
+            if not pool.any():
+                continue
+            sims = np.where(pool, table.sim[r], -np.inf)
+            partner = int(np.argmax(sims))
+            if sims[partner] > _BASE_THRESHOLD:
+                scores.append(float(sims[partner]))
+                claimed[partner] = True
+    return scores
+
+
+def _compute_dynamic_threshold(table: ElementTable) -> float:
+    """``max(0.5, 0.95 * min(outlier-pruned best-match scores))``."""
+    if len(table.lists) < 2:
+        return _BASE_THRESHOLD
+    kept = remove_outliers(sorted(_best_match_scores(table)))
+    if not kept:
+        return _BASE_THRESHOLD
+    return max(_BASE_THRESHOLD, 0.95 * kept[0])
+
+
+@dataclass
+class _Group:
+    """One support group during reference election."""
+
+    rep: int  # flat row id of the current representative
+    members: List[int] = field(default_factory=list)
+    source_lists: set = field(default_factory=set)
+
+
+def _elect_reference(
+    table: ElementTable, threshold: float, min_support_ratio: float
+) -> List[Index]:
+    """Elect reference elements by greedy similarity grouping.
+
+    Every element joins the most-similar existing group representative above
+    ``threshold`` whose group has no element from its source list yet, else
+    founds a new group. After each join the representative is re-elected as the
+    medoid of the member INDEX TUPLES (an index-space medoid — the spec calls
+    the primitive consensus on the (list_idx, pos) pairs themselves) and the
+    group moves to the back of the scan order, mirroring the reference's
+    dict-key reinsertion. Groups under ``min_support_ratio`` are dropped;
+    survivors are ordered by (-support, representative index).
+    """
+    from .primitive import consensus_as_primitive
+    from .similarity import SimilarityScorer
+
+    medoid_scorer = SimilarityScorer(method="embeddings", embed_fn=None)
+    medoid_settings = ConsensusSettings()
+    groups: List[_Group] = []
+
+    for r in range(len(table)):
+        src = int(table.owner[r])
+        best: Optional[_Group] = None
+        best_sim = -1.0
+        for g in groups:
+            if src in g.source_lists:
+                continue
+            s = table.sim[r, g.rep]
+            if s >= threshold and s > best_sim:
+                best_sim = s
+                best = g
+        if best is None:
+            groups.append(_Group(rep=r, members=[r], source_lists={src}))
+            continue
+        best.members.append(r)
+        best.source_lists.add(src)
+        elected, _ = consensus_as_primitive(
+            [table.element(m) for m in best.members], medoid_settings, medoid_scorer
         )
-        self.cache[key] = sim
-        self.cache[reverse_key] = sim
-        return sim
+        elected_row = table.row(elected)
+        if elected_row != best.rep:
+            best.rep = elected_row
+            groups.remove(best)
+            groups.append(best)
+
+    n_lists = len(table.lists)
+    ranked = [
+        (len(g.members) / n_lists, table.element(g.rep))
+        for g in groups
+        if len(g.members) / n_lists >= min_support_ratio
+    ]
+    ranked.sort(key=lambda t: (-t[0], t[1]))
+    return [idx for _, idx in ranked]
+
+
+def _assign_to_reference(
+    table: ElementTable, reference: List[Index], threshold: float
+) -> List[List[Any]]:
+    """Optimal one-to-one assignment of each list's elements to the reference
+    columns (Hungarian on 1 - similarity), keeping matches above ``threshold``."""
+    n_refs = len(reference)
+    out: List[List[Any]] = [[None] * n_refs for _ in table.lists]
+    if not n_refs:
+        return out
+    ref_rows = np.array([table.row(ix) for ix in reference])
+
+    for li, lst in enumerate(table.lists):
+        if not lst:
+            continue
+        rows = table.rows_of(li)
+        sims = table.sim[np.ix_(ref_rows, rows)]
+        picked_ref, picked_obj = linear_sum_assignment(1.0 - sims)
+        for rp, op in zip(picked_ref, picked_obj):
+            if sims[rp, op] >= threshold and out[li][rp] is None:
+                out[li][rp] = lst[op]
+    return out
 
 
 def _prune_low_support_elements(
@@ -62,216 +249,28 @@ def _prune_low_support_elements(
     """Remove columns whose non-None support falls below the threshold.
 
     If every column fails, the threshold relaxes to the max observed support —
-    the reference's emergency degradation (:136-138).
+    the emergency degradation of the spec (:136-138).
     """
     if not aligned_lists:
         return aligned_lists
-
-    n_lists = len(aligned_lists)
-    n_cols_set = set(len(lst) for lst in aligned_lists)
-    if len(n_cols_set) > 1:
+    widths = {len(lst) for lst in aligned_lists}
+    if len(widths) != 1:
         logger.warning("All lists must have the same number of columns")
         return aligned_lists
-    if not n_cols_set:
-        return aligned_lists
-    n_cols = n_cols_set.pop()
+    n_cols = widths.pop()
     if n_cols == 0:
         return aligned_lists
 
-    support = []
-    for col_idx in range(n_cols):
-        non_none_count = sum(1 for lst in aligned_lists if lst[col_idx] is not None)
-        support.append(non_none_count / n_lists)
-
-    max_support = max(support)
-    if max_support < min_support_ratio:
+    presence = np.array([[x is not None for x in lst] for lst in aligned_lists])
+    support = presence.mean(axis=0)
+    cutoff = min_support_ratio
+    if support.max() < cutoff:
         logger.warning(
-            "All columns below threshold, keeping columns with support %s", max_support
+            "All columns below threshold, keeping columns with support %s", support.max()
         )
-        min_support_ratio = max_support
-
-    keep_cols = [i for i, s in enumerate(support) if s >= min_support_ratio]
-    return [[lst[i] if i < len(lst) else None for i in keep_cols] for lst in aligned_lists]
-
-
-def low_cutoff_bound(scores) -> float:
-    """Outlier cutoff: a significant 'jump' near the low end of sorted scores."""
-    if len(scores) == 0:
-        return 0.0
-    eps = 0.0001
-    scores = np.sort(scores)
-    low_cutoff = scores[0]
-    diffs = np.diff(scores[: int(0.2 * len(scores))])
-    if len(diffs) > 0:
-        jump_threshold = np.median(diffs) * 3
-        jump_idx = np.argmax(diffs > jump_threshold)
-        if diffs[jump_idx] > jump_threshold:
-            low_cutoff = scores[jump_idx + 1] + eps  # epsilon makes it non-inclusive
-    return float(low_cutoff)
-
-
-def remove_outliers(data: List[float]) -> List[float]:
-    lower = low_cutoff_bound(data)
-    return [el for el in data if el >= lower]
-
-
-def _compute_dynamic_threshold(sim_cache: SimilarityCache) -> float:
-    """Threshold from the distribution of best-match scores across lists.
-
-    For each element (in list order), its best still-unused match in every *later*
-    list is recorded if it beats the 0.5 base; the threshold is
-    ``max(0.5, 0.95 * min(outlier-pruned scores))``.
-    """
-    list_of_lists = sim_cache.list_of_lists
-    BASE_THRESHOLD = 0.5
-    if not list_of_lists or len(list_of_lists) < 2:
-        return BASE_THRESHOLD
-
-    similarity_scores = []
-    total_lists = len(list_of_lists)
-
-    for i in range(total_lists):
-        list_i = list_of_lists[i]
-        if not list_i:
-            continue
-        used_elements: Dict[int, Set[int]] = {j: set() for j in range(total_lists) if j != i}
-
-        for k_i in range(len(list_i)):
-            best_match_score = BASE_THRESHOLD
-            best_match = None
-
-            for j in range(i + 1, total_lists):
-                list_j = list_of_lists[j]
-                if not list_j:
-                    continue
-                for k_j in range(len(list_j)):
-                    if k_j in used_elements[j]:
-                        continue
-                    sim = sim_cache.get((i, k_i), (j, k_j))
-                    if sim > best_match_score:
-                        best_match_score = sim
-                        best_match = (j, k_j)
-
-            if best_match is not None and best_match_score > 0:
-                similarity_scores.append(best_match_score)
-                used_elements[best_match[0]].add(best_match[1])
-
-    similarity_scores.sort()
-    similarity_scores = remove_outliers(similarity_scores)
-    if not similarity_scores:
-        return BASE_THRESHOLD
-    return max(BASE_THRESHOLD, 0.95 * similarity_scores[0])
-
-
-def _build_reference_list(
-    sim_cache: SimilarityCache,
-    min_support_ratio: float = 0.5,
-    max_novelty_ratio: float = 0.5,
-    threshold: float = 0.4,
-) -> List[Index]:
-    """Elect reference elements by greedy similarity grouping.
-
-    Groups enforce one element per source list; each addition re-elects the group
-    representative as the medoid of the group's index tuples (the reference calls
-    ``consensus_as_primitive`` on the (list_idx, pos) tuples themselves with
-    default settings — :308-318 — an index-space medoid we replicate exactly).
-    Groups below ``min_support_ratio`` are dropped; survivors are ordered by
-    (-support_ratio, index).
-    """
-    list_of_lists = sim_cache.list_of_lists
-
-    unused_positions = {idx: set(range(len(lst))) for idx, lst in enumerate(list_of_lists)}
-    candidate_elements = [
-        (list_idx, obj_pos)
-        for list_idx, unused_indices in unused_positions.items()
-        for obj_pos in unused_indices
-    ]
-
-    support_groups: Dict[Index, List[Index]] = defaultdict(list)
-    support_groups_used_lists: Dict[Index, Set[int]] = defaultdict(set)
-
-    # Scorer for the index-tuple medoid re-election; strings never occur in index
-    # space, so no embedding provider is needed.
-    reelection_scorer = SimilarityScorer(method="embeddings", embed_fn=None)
-
-    for list_idx1, obj_pos1 in candidate_elements:
-        obj_index1 = (list_idx1, obj_pos1)
-
-        best_sim = -1.0
-        best_group_repr_index: Optional[Index] = None
-        for group_repr_index, group_used_lists in support_groups_used_lists.items():
-            if list_idx1 in group_used_lists:
-                continue  # all elements in a group must come from different lists
-            sim = sim_cache.get(obj_index1, group_repr_index)
-            if sim >= threshold and sim > best_sim:
-                best_sim = sim
-                best_group_repr_index = group_repr_index
-
-        if best_group_repr_index is not None:
-            support_groups[best_group_repr_index].append(obj_index1)
-            support_groups_used_lists[best_group_repr_index].add(list_idx1)
-
-            new_group_repr_index, _ = consensus_as_primitive(
-                support_groups[best_group_repr_index],
-                ConsensusSettings(),
-                reelection_scorer,
-            )
-            if new_group_repr_index != best_group_repr_index:
-                support_groups[new_group_repr_index] = support_groups[best_group_repr_index]
-                support_groups_used_lists[new_group_repr_index] = support_groups_used_lists[
-                    best_group_repr_index
-                ]
-                del support_groups[best_group_repr_index]
-                del support_groups_used_lists[best_group_repr_index]
-        else:
-            support_groups[obj_index1] = [obj_index1]
-            support_groups_used_lists[obj_index1] = {list_idx1}
-
-    support_ratios: Dict[Index, float] = {
-        k: len(v) / len(list_of_lists) for k, v in support_groups.items()
-    }
-    support_ratios = {k: v for k, v in support_ratios.items() if v >= min_support_ratio}
-    support_ratios = dict(sorted(support_ratios.items(), key=lambda x: (-x[1], x[0])))
-
-    return list(support_ratios.keys())
-
-
-def _align_lists_to_reference_hungarian(
-    sim_cache: SimilarityCache,
-    reference_indices: List[Index],
-    threshold: float = 0.4,
-) -> List[List[Any]]:
-    list_of_lists = sim_cache.list_of_lists
-    n_lists = len(list_of_lists)
-    n_refs = len(reference_indices)
-
-    aligned_lists: List[List[Any]] = [[None for _ in range(n_refs)] for _ in range(n_lists)]
-    if not reference_indices:
-        return aligned_lists
-
-    for list_idx, lst in enumerate(list_of_lists):
-        n_objs = len(lst)
-        if n_objs == 0:
-            continue
-
-        sim_matrix = np.full((n_refs, n_objs), -np.inf)
-        for ref_pos, ref_index in enumerate(reference_indices):
-            for obj_pos in range(n_objs):
-                obj_index = (list_idx, obj_pos)
-                if obj_index == ref_index:
-                    sim_matrix[ref_pos, obj_pos] = 1.0
-                    continue
-                sim_matrix[ref_pos, obj_pos] = sim_cache.get(obj_index, ref_index)
-
-        cost_matrix = 1.0 - sim_matrix
-        row_ind, col_ind = linear_sum_assignment(cost_matrix)
-
-        for ref_pos, obj_pos in zip(row_ind, col_ind):
-            sim = sim_matrix[ref_pos, obj_pos]
-            if sim >= threshold and aligned_lists[list_idx][ref_pos] is None:
-                aligned_lists[list_idx][ref_pos] = lst[obj_pos]
-
-    return aligned_lists
+        cutoff = support.max()
+    keep = np.flatnonzero(support >= cutoff)
+    return [[lst[i] for i in keep] for lst in aligned_lists]
 
 
 def lists_alignment(
@@ -284,33 +283,22 @@ def lists_alignment(
     """Align lists of objects by element similarity.
 
     Returns (aligned_lists, original_position_indices). When
-    ``reference_list_idx`` is given, that list is ground truth: alignment runs at
-    threshold 0 with no pruning or reordering.
+    ``reference_list_idx`` is given, that list is ground truth: alignment runs
+    at threshold 0 with no pruning or reordering.
     """
-    if not list_of_lists or all(not lst for lst in list_of_lists):
-        return [[] for _ in list_of_lists], [
-            [None for _ in range(len(lst))] for lst in list_of_lists
-        ]
+    if not any(list_of_lists):
+        return [[] for _ in list_of_lists], [[None] * len(lst) for lst in list_of_lists]
 
-    sim_cache = SimilarityCache(sim_fn, list_of_lists)
+    table = ElementTable(sim_fn, list_of_lists, anchor_list=reference_list_idx)
 
-    if reference_list_idx is None:
-        dynamic_threshold = _compute_dynamic_threshold(sim_cache)
-        reference_list = _build_reference_list(
-            sim_cache, min_support_ratio, max_novelty_ratio, threshold=dynamic_threshold
-        )
-        aligned = _align_lists_to_reference_hungarian(
-            sim_cache, reference_list, threshold=0.95 * dynamic_threshold
-        )
-        aligned = _prune_low_support_elements(aligned, min_support_ratio)
-        aligned, original_list_reference_indices = sort_by_original_majority(
-            aligned, list_of_lists
-        )
-    else:
-        reference_list = [
-            (reference_list_idx, i) for i in range(len(list_of_lists[reference_list_idx]))
-        ]
-        aligned = _align_lists_to_reference_hungarian(sim_cache, reference_list, threshold=0.0)
-        original_list_reference_indices = _original_positions(aligned, list_of_lists)
+    if reference_list_idx is not None:
+        anchor = list_of_lists[reference_list_idx]
+        reference = [(reference_list_idx, i) for i in range(len(anchor))]
+        aligned = _assign_to_reference(table, reference, threshold=0.0)
+        return aligned, _original_positions(aligned, list_of_lists)
 
-    return aligned, original_list_reference_indices
+    threshold = _compute_dynamic_threshold(table)
+    reference = _elect_reference(table, threshold, min_support_ratio)
+    aligned = _assign_to_reference(table, reference, threshold=0.95 * threshold)
+    aligned = _prune_low_support_elements(aligned, min_support_ratio)
+    return sort_by_original_majority(aligned, list_of_lists)

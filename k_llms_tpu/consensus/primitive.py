@@ -1,20 +1,16 @@
 """Primitive (non-enum) consensus: llm-consensus strings, hybrid numeric
 clustering, and the similarity-medoid fallback.
 
-Parity target: ``consensus_as_primitive`` at
-`/root/reference/k_llms/utils/consensus_utils.py:1075-1237`:
-
-- (a) llm-consensus string mode (:1090-1096): ask a model for a consensus string;
-  confidence = mean similarity of candidates to it. The reference hardcodes an
-  OpenAI ``gpt-5-mini`` call (:1026-1048); here the caller supplies
-  ``llm_consensus_fn`` (the TPU backend routes it to the local model).
-- (b) hybrid numeric (:1098-1219): sort, 1-D cluster with rel/abs eps,
-  None-majority rules, tie-break by cross-cluster support including sign-less and
-  power-of-10 closeness; representative = cluster mean.
-- (c) similarity medoid (:1221-1237): full pairwise similarity matrix, pick the
-  row-mean argmax; confidence = that mean.
-
-Every threshold, rounding (5 decimals), and tie-break key is kept bit-compatible.
+Behavioral spec: ``consensus_as_primitive`` at
+`/root/reference/k_llms/utils/consensus_utils.py:1075-1237` — every threshold,
+rounding (5 decimals), and tie-break key is kept bit-compatible and pinned by
+the differential oracle. The implementation is vectorized: sorted values are
+segmented into clusters with one boolean gap vector, and the tied-cluster
+support tie-break evaluates all three closeness predicates (direct, sign-less,
+power-of-10) as broadcast matrices over cluster centers rather than scanning
+pairs. The llm-consensus string mode takes a caller-supplied
+``llm_consensus_fn`` (the TPU backend routes it to the local model) instead of
+the reference's hardcoded OpenAI ``gpt-5-mini`` call (:1026-1048).
 """
 
 from __future__ import annotations
@@ -30,50 +26,170 @@ from .similarity import SimilarityScorer
 LlmConsensusFn = Callable[[List[str]], str]
 
 
+def _pairwise_matrix(values: List[Any], scorer: SimilarityScorer, diag: float) -> np.ndarray:
+    """Symmetric generic-similarity matrix with a fixed diagonal."""
+    n = len(values)
+    sim = np.full((n, n), diag, dtype=float)
+    for a in range(n):
+        row = sim[a]
+        for b in range(a + 1, n):
+            row[b] = sim[b, a] = scorer.generic(values[a], values[b])
+    return sim
+
+
+def _close_matrix(a: np.ndarray, b: np.ndarray, rel_eps: float, abs_eps: float) -> np.ndarray:
+    """Broadcast |a - b| <= max(abs_eps, rel_eps * max(|a|, |b|, 1))."""
+    a = a[:, None]
+    b = b[None, :]
+    tol = np.maximum(abs_eps, rel_eps * np.maximum(np.maximum(np.abs(a), np.abs(b)), 1.0))
+    return np.abs(a - b) <= tol
+
+
+def _segment_sorted(xs: np.ndarray, rel_eps: float, abs_eps: float) -> List[np.ndarray]:
+    """Chain-cluster a sorted 1-D array: a new segment starts wherever the gap
+    to the previous value exceeds the mixed absolute/relative tolerance."""
+    if xs.size == 0:
+        return []
+    left, right = xs[:-1], xs[1:]
+    tol = np.maximum(abs_eps, rel_eps * np.maximum(np.maximum(np.abs(left), np.abs(right)), 1.0))
+    breaks = np.flatnonzero(np.abs(right - left) > tol) + 1
+    return np.split(xs, breaks)
+
+
+def _finite_floats(values: List[Any]) -> np.ndarray:
+    """The finite numeric payload of ``values`` (bools excluded), sorted."""
+    out = []
+    for v in values:
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            try:
+                f = float(v)
+            except Exception:
+                continue
+            if math.isfinite(f):
+                out.append(f)
+    return np.sort(np.asarray(out, dtype=float))
+
+
+def _numeric_consensus(
+    values: List[Any], settings: ConsensusSettings, parent_valid_frac: float
+) -> Tuple[Optional[float], float]:
+    """Hybrid numeric consensus with None-aware confidence (spec :1098-1219)."""
+    total = len(values)
+    none_count = sum(v is None for v in values)
+
+    xs = _finite_floats(values)
+    if xs.size == 0:
+        return None, parent_valid_frac
+
+    clusters = _segment_sorted(xs, settings.rel_eps, settings.abs_eps)
+    sizes = np.array([c.size for c in clusters])
+    biggest = int(sizes.max())
+    top = max(biggest, none_count)
+
+    if none_count > biggest:
+        return None, round(none_count / total, 5)
+
+    # A strict majority, or a unique largest block, decides outright.
+    contenders = int((sizes == top).sum()) + (1 if none_count == top else 0)
+    if top > total / 2 or contenders == 1:
+        if none_count == top:
+            return None, round(none_count / total, 5)
+        winner = clusters[int(np.argmax(sizes))]
+        return float(winner.mean()), round(top / total, 5)
+
+    # Tied largest blocks: rank by cross-cluster support. A candidate absorbs
+    # every strictly-smaller cluster whose center is close to its own directly,
+    # after dropping signs, or after a power-of-10 shift (common LLM slips).
+    centers = np.array([float(np.median(c)) for c in clusters])
+    spreads = np.array([float(np.std(c)) if c.size > 1 else 0.0 for c in clusters])
+    rel, ae = settings.rel_eps, settings.abs_eps
+
+    near = _close_matrix(centers, centers, rel, ae)
+    near |= _close_matrix(np.abs(centers), np.abs(centers), rel, ae)
+    shifts = 10.0 ** np.arange(-6, 7)
+    nz = centers != 0.0
+    for s in shifts:
+        shifted = _close_matrix(centers, centers * s, rel, ae)
+        near |= shifted & nz[:, None] & nz[None, :]
+
+    absorbable = sizes[None, :] < sizes[:, None]  # [cand, other]
+    gained = np.where(near & absorbable, sizes[None, :], 0).sum(axis=1)
+
+    board: List[Tuple[float, int, float, float, int]] = []
+    for rank, ci in enumerate(np.flatnonzero(sizes == top)):
+        ci = int(ci)
+        board.append(
+            (
+                -(sizes[ci] + gained[ci]),  # total support, descending
+                0,  # numeric candidates outrank the None candidate
+                float(spreads[ci]),  # tighter cluster wins
+                -abs(float(centers[ci])),  # then larger magnitude
+                ci,
+            )
+        )
+    if none_count == top:
+        board.append((-float(none_count), 1, float("inf"), 0.0, -1))
+    board.sort(key=lambda t: t[:4])
+    support, _, _, _, idx = board[0]
+    if idx < 0:
+        return None, round(none_count / total, 5)
+    return float(clusters[idx].mean()), round(-support / total, 5)
+
+
+def _medoid_consensus(
+    values: List[Any], scorer: SimilarityScorer, parent_valid_frac: float
+) -> Tuple[Any, float]:
+    """Similarity medoid (spec :1221-1237): the value with the highest mean
+    similarity to the others wins; that mean (scaled) is the confidence."""
+    sim = _pairwise_matrix(values, scorer, diag=np.nan)
+    mean_to_others = np.nanmean(sim, axis=1)
+    best = int(np.argmax(mean_to_others))
+    return values[best], round(parent_valid_frac * float(mean_to_others[best]), 5)
+
+
+def _looks_numeric(non_none: List[Any]) -> bool:
+    """The spec's type gate (:1099): the first value's type default must be an
+    int/float instance — for bool that default (False) IS an int, so all-bool
+    input takes the numeric branch and returns (None, parent_valid_frac) —
+    or every non-None value must be numeric."""
+    head_default = type(non_none[0])()
+    return isinstance(head_default, (int, float)) or all(
+        isinstance(v, (int, float)) for v in non_none
+    )
+
+
 def _weighted_numeric_consensus(
     xs: List[float], ws: List[float], total_weight: float, settings: ConsensusSettings
 ) -> Tuple[float, float]:
     """Weighted 1-D clustering: cluster mass = sum of member weights; the
     heaviest cluster wins and its weighted mean represents it."""
-    pairs = sorted(zip(xs, ws))
-
-    def _is_close(a: float, b: float) -> bool:
-        denom = max(abs(a), abs(b), 1.0)
-        return abs(b - a) <= max(settings.abs_eps, settings.rel_eps * denom)
-
-    clusters: List[List[Tuple[float, float]]] = [[pairs[0]]]
-    for prev, cur in zip(pairs, pairs[1:]):
-        if _is_close(prev[0], cur[0]):
-            clusters[-1].append(cur)
-        else:
-            clusters.append([cur])
-
-    def mass(c):
-        return sum(w for _, w in c)
-
-    best = max(clusters, key=mass)
-    m = mass(best)
-    rep = sum(x * w for x, w in best) / m
-    return rep, round(m / total_weight, 5)
+    order = np.lexsort((ws, xs))
+    x = np.asarray(xs, dtype=float)[order]
+    w = np.asarray(ws, dtype=float)[order]
+    tol = np.maximum(
+        settings.abs_eps,
+        settings.rel_eps * np.maximum(np.maximum(np.abs(x[:-1]), np.abs(x[1:])), 1.0),
+    )
+    breaks = np.flatnonzero(np.abs(x[1:] - x[:-1]) > tol) + 1
+    seg_x = np.split(x, breaks)
+    seg_w = np.split(w, breaks)
+    masses = np.array([sw.sum() for sw in seg_w])
+    best = int(np.argmax(masses))
+    rep = float((seg_x[best] * seg_w[best]).sum() / masses[best])
+    return rep, round(float(masses[best]) / total_weight, 5)
 
 
 def _weighted_medoid(
     values: List[Any], ws: List[float], scorer: SimilarityScorer, parent_valid_frac: float
 ) -> Tuple[Any, float]:
     """Medoid under weighted mean similarity (self excluded)."""
-    n = len(values)
-    sim = np.zeros((n, n))
-    for i in range(n):
-        for j in range(i + 1, n):
-            sim[i, j] = sim[j, i] = scorer.generic(values[i], values[j])
-    w = np.asarray(ws)
-    weighted_rows = np.zeros(n)
-    for i in range(n):
-        others = np.arange(n) != i
-        denom = w[others].sum()
-        weighted_rows[i] = (sim[i, others] * w[others]).sum() / denom if denom else 0.0
-    best_idx = int(np.argmax(weighted_rows))
-    return values[best_idx], round(parent_valid_frac * float(weighted_rows[best_idx]), 5)
+    sim = _pairwise_matrix(values, scorer, diag=0.0)
+    w = np.asarray(ws, dtype=float)
+    denom = w.sum() - w  # per-row weight of the others
+    weighted = (sim * w[None, :]).sum(axis=1)
+    rows = np.divide(weighted, denom, out=np.zeros_like(weighted), where=denom != 0)
+    best = int(np.argmax(rows))
+    return values[best], round(parent_valid_frac * float(rows[best]), 5)
 
 
 def consensus_as_primitive(
@@ -84,13 +200,11 @@ def consensus_as_primitive(
     llm_consensus_fn: Optional[LlmConsensusFn] = None,
     weights: Optional[List[float]] = None,
 ) -> Tuple[Any, float]:
-    non_none_values = [v for v in values if v is not None]
-    if len(non_none_values) == 0:
-        return (None, parent_valid_frac)
-    if len(non_none_values) == 1:
-        return (non_none_values[0], parent_valid_frac * (len(non_none_values) / len(values)))
-
-    first_val_type = type(non_none_values[0])
+    non_none = [v for v in values if v is not None]
+    if not non_none:
+        return None, parent_valid_frac
+    if len(non_none) == 1:
+        return non_none[0], parent_valid_frac * (1 / len(values))
 
     # Strictly-additional likelihood-weighted mode: weighted clustering/medoid.
     # The weights-None path below stays bit-identical to the reference.
@@ -101,10 +215,7 @@ def consensus_as_primitive(
             for v, w in zip(values, weights)
             if isinstance(v, (int, float)) and not isinstance(v, bool) and math.isfinite(float(v))
         ]
-        if pairs and (
-            isinstance(first_val_type(), (int, float))
-            or all(isinstance(v, (int, float)) for v in non_none_values)
-        ):
+        if pairs and _looks_numeric(non_none):
             return _weighted_numeric_consensus(
                 [x for x, _ in pairs], [w for _, w in pairs], total_weight, consensus_settings
             )
@@ -117,7 +228,7 @@ def consensus_as_primitive(
 
     # (a) llm-consensus string mode — only with embeddings similarity (:1090).
     if (
-        first_val_type is str
+        type(non_none[0]) is str
         and consensus_settings.string_consensus_method == "llm-consensus"
         and consensus_settings.string_similarity_method == "embeddings"
     ):
@@ -126,172 +237,25 @@ def consensus_as_primitive(
                 "string_consensus_method='llm-consensus' requires an llm_consensus_fn "
                 "(the TPU backend provides one automatically)"
             )
-        consensus_string = llm_consensus_fn(non_none_values)
-        similarities = [scorer.generic(consensus_string, v) for v in non_none_values]
-        confidence = float(np.nanmean(similarities))
-        return consensus_string, confidence
+        candidate = llm_consensus_fn(non_none)
+        sims = [scorer.generic(candidate, v) for v in non_none]
+        return candidate, float(np.nanmean(sims))
 
     # (b) hybrid numeric consensus with None-aware confidence.
-    # NB: `first_val_type()` constructs the type's default instance — for bool that
-    # default is False, which IS an int instance, so all-bool inputs take this
-    # branch and (xs being empty) return (None, parent_valid_frac), exactly like
-    # the reference (:1099-1116).
-    if isinstance(first_val_type(), (int, float)) or all(
-        isinstance(v, (int, float)) for v in non_none_values
-    ):
-        total = len(values)
-        none_count = sum(1 for v in values if v is None)
-        frac_none = none_count / total if total else 0.0
-
-        xs: list[float] = []
-        for v in values:
-            if isinstance(v, bool):
-                continue
-            if isinstance(v, (int, float)):
-                try:
-                    vf = float(v)
-                    if math.isfinite(vf):
-                        xs.append(vf)
-                except Exception:
-                    pass
-        if not xs:
-            return (None, parent_valid_frac)
-
-        xs.sort()
-
-        def _cluster_1d(xs_sorted: list[float]) -> list[list[float]]:
-            if not xs_sorted:
-                return []
-
-            def _is_close(a: float, b: float) -> bool:
-                denom = max(abs(a), abs(b), 1.0)
-                rel_tol = consensus_settings.rel_eps * denom
-                return abs(b - a) <= max(consensus_settings.abs_eps, rel_tol)
-
-            clusters_local: list[list[float]] = []
-            current = [xs_sorted[0]]
-            for i in range(len(xs_sorted) - 1):
-                a, b = xs_sorted[i], xs_sorted[i + 1]
-                if _is_close(a, b):
-                    current.append(b)
-                else:
-                    clusters_local.append(current)
-                    current = [b]
-            clusters_local.append(current)
-            return clusters_local
-
-        rel_eps = consensus_settings.rel_eps
-        abs_eps = consensus_settings.abs_eps
-
-        def _is_close_absrel(a: float, b: float) -> bool:
-            denom = max(abs(a), abs(b), 1.0)
-            return abs(a - b) <= max(abs_eps, rel_eps * denom)
-
-        def _is_close_signless(a: float, b: float) -> bool:
-            return _is_close_absrel(abs(a), abs(b))
-
-        def _is_close_power10(a: float, b: float, k_range: tuple[int, int] = (-6, 6)) -> bool:
-            if a == 0.0 or b == 0.0:
-                return _is_close_absrel(a, b)
-            for k in range(k_range[0], k_range[1] + 1):
-                if _is_close_absrel(a, b * (10.0**k)):
-                    return True
-            return False
-
-        clusters = _cluster_1d(xs)
-        sizes_num = [len(c) for c in clusters]
-        max_size_num = max((len(c) for c in clusters), default=0)
-        sizes_all = sizes_num + ([none_count] if none_count > 0 else [])
-        max_size_all = max(sizes_all) if sizes_all else 0
-
-        if none_count > max_size_num:
-            return (None, round(frac_none, 5))
-
-        if max_size_all > total / 2:
-            if none_count > 0 and none_count == max_size_all:
-                return (None, round(none_count / total, 5))
-            max_idx = int(np.argmax(sizes_num))
-            rep = float(np.mean(clusters[max_idx]))
-            return (rep, round(max_size_all / total, 5))
-
-        if sizes_all.count(max_size_all) == 1:
-            if none_count > 0 and none_count == max_size_all:
-                return (None, round(none_count / total, 5))
-            max_idx = int(np.argmax(sizes_num))
-            rep = float(np.mean(clusters[max_idx]))
-            return (rep, round(max_size_all / total, 5))
-
-        # Tied largest clusters: break by cross-cluster "support" — a candidate
-        # absorbs smaller clusters whose centers are close outright, sign-less
-        # close, or close after a power-of-10 shift (common LLM numeric slips).
-        candidate_indices = [i for i, c in enumerate(clusters) if len(c) == max_size_all]
-        include_none_candidate = none_count > 0 and none_count == max_size_all
-        centers = [float(np.median(c)) if c else float("nan") for c in clusters]
-        spreads = [float(np.std(c)) if len(c) > 1 else 0.0 for c in clusters]
-        supports: list[tuple[str, int, int]] = []
-        for ci in candidate_indices:
-            support = len(clusters[ci])
-            c_center = centers[ci]
-            for oi, other in enumerate(clusters):
-                if oi == ci:
-                    continue
-                if len(other) < len(clusters[ci]):
-                    o_center = centers[oi]
-                    if (
-                        _is_close_absrel(c_center, o_center)
-                        or _is_close_signless(c_center, o_center)
-                        or _is_close_power10(c_center, o_center)
-                    ):
-                        support += len(other)
-            supports.append(("numeric", ci, support))
-        if include_none_candidate:
-            supports.append(("none", -1, none_count))
-        supports.sort(
-            key=lambda t: (
-                -t[2],
-                1 if t[0] != "numeric" else 0,
-                spreads[t[1]] if t[1] >= 0 else float("inf"),
-                -abs(centers[t[1]]) if t[1] >= 0 else 0.0,
-            )
-        )
-        best_kind, best_idx, best_support = supports[0]
-        if best_kind == "none":
-            return (None, round(best_support / total, 5))
-        rep = float(np.mean(clusters[best_idx]))
-        return (rep, round(best_support / total, 5))
+    if _looks_numeric(non_none):
+        return _numeric_consensus(values, consensus_settings, parent_valid_frac)
 
     # (c) similarity medoid (strings or other structures).
-    n = len(values)
-    if n == 0:
-        return (None, 0.0)
-    if n == 1:
-        return (values[0], parent_valid_frac)
-    sim_matrix = np.zeros((n, n), dtype=float)
-    for i in range(n):
-        for j in range(i + 1, n):
-            sim = scorer.generic(values[i], values[j])
-            sim_matrix[i, j] = sim_matrix[j, i] = sim
-        sim_matrix[i, i] = np.nan
-    avg_sims = np.nanmean(sim_matrix, axis=1)
-    best_idx = int(np.argmax(avg_sims))
-    best_value = values[best_idx]
-    confidence = parent_valid_frac * float(avg_sims[best_idx])
-    return (best_value, round(confidence, 5))
+    return _medoid_consensus(values, scorer, parent_valid_frac)
 
 
 def compute_similarity_scores(values: list, scorer: SimilarityScorer) -> list:
     """Per-value mean similarity against all values (self included, at 1.0) —
-    scores without electing a winner. Parity: ``compute_similarity_scores``,
+    scores without electing a winner. Spec: ``compute_similarity_scores``,
     `/root/reference/k_llms/utils/consensus_utils.py:1243-1263`."""
-    n = len(values)
-    if n == 0:
+    if not values:
         return []
-    if n == 1:
+    if len(values) == 1:
         return [1.0]
-    sim_matrix = np.zeros((n, n), dtype=float)
-    for i in range(n):
-        for j in range(i + 1, n):
-            sim = scorer.generic(values[i], values[j])
-            sim_matrix[i, j] = sim_matrix[j, i] = sim
-        sim_matrix[i, i] = 1.0
-    return [float(round(score, 5)) for score in sim_matrix.mean(axis=1)]
+    sim = _pairwise_matrix(values, scorer, diag=1.0)
+    return [float(round(s, 5)) for s in sim.mean(axis=1)]

@@ -1,13 +1,14 @@
 """Recursive alignment and the type-directed consensus dispatcher.
 
-Parity targets in `/root/reference/k_llms/utils/consensus_utils.py`:
-``exists_nested_lists`` :433-455, ``recursive_list_alignments`` :458-613 (walks
+Behavioral spec in `/root/reference/k_llms/utils/consensus_utils.py`:
+``exists_nested_lists`` :433-455, ``recursive_list_alignments`` :458-613 (walk
 dicts per-key and lists per-position, returning aligned values plus key-mapping
 paths back to original source positions), ``consensus_dict`` :1269-1306,
-``consensus_list`` :1309-1352, and the dispatcher ``consensus_values`` :1376-1454
-(str/bool with every value under 3 words => voting; dict => field recursion with
-``parent_valid_frac`` scaled by the dict-typed fraction; list => element-wise
-recursion; else primitive consensus).
+``consensus_list`` :1309-1352, and the dispatcher ``consensus_values``
+:1376-1454 (str/bool with every value under 3 words => voting; dict => field
+recursion with ``parent_valid_frac`` scaled by the dict-typed fraction; list =>
+element-wise recursion; else primitive consensus). Pinned by the differential
+oracle; structured here as a dispatcher plus per-shape descent helpers.
 
 Signature change vs the reference: similarity flows through a
 :class:`SimilarityScorer` (and optional ``llm_consensus_fn``) rather than an
@@ -17,7 +18,7 @@ OpenAI-embeddings callback plus client.
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from .alignment import lists_alignment
 from .primitive import LlmConsensusFn, consensus_as_primitive
@@ -25,18 +26,106 @@ from .settings import SPECIAL_FIELD_PREFIXES, ConsensusSettings
 from .similarity import SimilarityScorer
 from .voting import voting_consensus
 
+PathMap = Dict[str, List[Optional[str]]]
+
 
 def exists_nested_lists(values: List[Any]) -> bool:
     """True if any value is a list, or a dict containing nested lists."""
-    if not values:
-        return False
-    for v in values:
-        if isinstance(v, list):
-            return True
-        elif isinstance(v, dict):
-            if exists_nested_lists(list(v.values())):
-                return True
-    return False
+    return any(
+        isinstance(v, list)
+        or (isinstance(v, dict) and exists_nested_lists(list(v.values())))
+        for v in values
+    )
+
+
+def _aligned_path(prefix: str, pos: int, leaf: str) -> str:
+    base = f"{prefix}.{pos}" if prefix else str(pos)
+    return f"{base}.{leaf}" if leaf else base
+
+
+def _source_path(prefix: str, pos: int, leaf: str):
+    # Quirk kept for parity: with no prefix and no leaf the reference leaves the
+    # position as a bare int, so root-level scalar lists map to ints not strings.
+    base = f"{prefix}.{pos}" if prefix else pos
+    return f"{base}.{leaf}" if leaf else base
+
+
+def _descend_keys(
+    values: List[Any],
+    scorer: SimilarityScorer,
+    min_support_ratio: float,
+    max_novelty_ratio: float,
+    prefix: str,
+    reference_idx: Optional[int],
+) -> Tuple[List[Any], PathMap]:
+    """Per-key recursion over dict samples (Nones become empty shells; every
+    output dict carries the full key union, in sorted order)."""
+    shells = [v if isinstance(v, dict) else {} for v in values]
+    keys = sorted({k for d in shells for k in d})
+    mappings: PathMap = {}
+    for key in keys:
+        column, sub = recursive_list_alignments(
+            [d.get(key) for d in shells],
+            scorer,
+            min_support_ratio,
+            max_novelty_ratio=max_novelty_ratio,
+            current_path=f"{prefix}.{key}" if prefix else key,
+            reference_idx=reference_idx,
+        )
+        for shell, aligned in zip(shells, column):
+            shell[key] = aligned
+        mappings.update(sub)
+    return [{k: d.get(k) for k in keys} for d in shells], mappings
+
+
+def _descend_positions(
+    values: List[Any],
+    scorer: SimilarityScorer,
+    min_support_ratio: float,
+    max_novelty_ratio: float,
+    prefix: str,
+    reference_idx: Optional[int],
+) -> Tuple[List[Any], PathMap]:
+    """Structural alignment of list samples, then per-column recursion with the
+    path map rewritten through each sample's pre-alignment positions."""
+    rows = [v if isinstance(v, list) else [] for v in values]
+    sources: List[List[Optional[int]]] = [[None] * len(r) for r in rows]
+    if any(rows):
+        rows, sources = lists_alignment(
+            rows,
+            scorer.generic,
+            min_support_ratio=min_support_ratio,
+            max_novelty_ratio=max_novelty_ratio,
+            reference_list_idx=reference_idx,
+        )
+    else:
+        rows = [[] for _ in rows]
+
+    mappings: PathMap = {}
+    width = len(rows[0]) if rows else 0
+    for col in range(width):
+        aligned_col, sub = recursive_list_alignments(
+            [r[col] for r in rows],
+            scorer,
+            min_support_ratio,
+            max_novelty_ratio=max_novelty_ratio,
+            current_path="",
+            reference_idx=reference_idx,
+        )
+        for r, v in zip(rows, aligned_col):
+            r[col] = v
+        for leaf, per_sample in sub.items():
+            rewritten: List[Optional[str]] = []
+            for r_idx, leaf_val in enumerate(per_sample):
+                origin = sources[r_idx][col]
+                if origin is None or leaf_val is None:
+                    rewritten.append(None)
+                else:
+                    rewritten.append(_source_path(prefix, origin, leaf_val))
+            mappings[_aligned_path(prefix, col, leaf)] = rewritten
+    if width == 0 and prefix:  # empty root paths are not supported
+        mappings[prefix] = [prefix] * len(values)
+    return rows, mappings
 
 
 def recursive_list_alignments(
@@ -46,7 +135,7 @@ def recursive_list_alignments(
     max_novelty_ratio: float = 0.25,
     current_path: str = "",
     reference_idx: Optional[int] = None,
-) -> Tuple[List[Any], Dict[str, List[Optional[str]]]]:
+) -> Tuple[List[Any], PathMap]:
     """Recursively align nested dicts/lists across the n samples.
 
     Returns the aligned values (same outer structure) and a mapping from each
@@ -55,183 +144,39 @@ def recursive_list_alignments(
     """
     if not values:
         return values, {}
-
     if all(v is None for v in values):
-        return values, {current_path: [current_path for _ in values]}
+        return values, {current_path: [current_path] * len(values)}
 
-    non_nulls = [v for v in values if v is not None]
+    values = deepcopy(values)  # descent helpers mutate nested structure
+    present = [v for v in values if v is not None]
+    head = type(present[0])
+    uniform = all(isinstance(v, head) for v in present)
 
-    # Defensive copy: alignment mutates the nested structure in place.
-    values = deepcopy(values)
-
-    first_type = type(non_nulls[0])
-    same_type = all(isinstance(x, first_type) for x in non_nulls)
-    key_mappings: Dict[str, List[Optional[str]]] = {}
-
-    if not same_type or first_type not in (dict, list):
-        key_mappings[current_path] = [
-            current_path if (v is not None or idx == reference_idx) else None
-            for idx, v in enumerate(values)
-        ]
-        return values, key_mappings
-
-    if first_type is dict:
-        dicts_only = [(d if isinstance(d, dict) else {}) for d in values]
-
-        all_keys = list(set(k for d in dicts_only for k in d.keys()))
-        all_keys.sort()
-
-        for key in all_keys:
-            values_for_key = [d.get(key) for d in dicts_only]
-            _current_path = f"{current_path}.{key}" if current_path else key
-            aligned_values_for_key, sub_key_mapping = recursive_list_alignments(
-                values_for_key,
-                scorer,
-                min_support_ratio,
-                max_novelty_ratio=max_novelty_ratio,
-                current_path=_current_path,
-                reference_idx=reference_idx,
-            )
-            for _d, aligned_value in zip(dicts_only, aligned_values_for_key):
-                _d[key] = aligned_value
-            key_mappings.update(sub_key_mapping)
-
-        values = [{k: _d.get(k) for k in all_keys} for _d in dicts_only]
-
-    if first_type is list:
-        lists_only = [(lst if isinstance(lst, list) else []) for lst in values]
-        original_list_reference_indices: List[List[Optional[int]]] = [
-            [None for _ in lst] for lst in lists_only
-        ]
-
-        if any(lst for lst in lists_only):
-            aligned_lists_only, original_list_reference_indices = lists_alignment(
-                lists_only,
-                scorer.generic,
-                min_support_ratio=min_support_ratio,
-                max_novelty_ratio=max_novelty_ratio,
-                reference_list_idx=reference_idx,
-            )
-            for l_idx, new_lst in enumerate(aligned_lists_only):
-                values[l_idx] = new_lst
-        else:
-            for i in range(len(values)):
-                values[i] = []
-
-        if len(values) > 0:
-            list_length = len(values[0])
-            if list_length > 0:
-                for i in range(list_length):
-                    values_i = [lst[i] for lst in values]
-                    values_i, sub_key_mapping = recursive_list_alignments(
-                        values_i,
-                        scorer,
-                        min_support_ratio,
-                        max_novelty_ratio=max_novelty_ratio,
-                        current_path="",
-                        reference_idx=reference_idx,
-                    )
-                    for l_idx, new_lst in enumerate(values_i):
-                        values[l_idx][i] = new_lst
-
-                    # Rewrite sub-paths through the original positions so the
-                    # mapping points at where each value came from pre-alignment.
-                    for key, sub_values in sub_key_mapping.items():
-                        _key_path = f"{current_path}.{i}" if current_path else str(i)
-                        _key_path = f"{_key_path}.{key}" if key else _key_path
-                        current_values: List[Optional[str]] = []
-                        for l_idx, v in enumerate(sub_values):
-                            _original_position = original_list_reference_indices[l_idx][i]
-                            if _original_position is None or v is None:
-                                current_values.append(None)
-                            else:
-                                _original_value_path = (
-                                    f"{current_path}.{_original_position}"
-                                    if current_path
-                                    else _original_position
-                                )
-                                _original_value_path = (
-                                    f"{_original_value_path}.{v}" if v else _original_value_path
-                                )
-                                current_values.append(_original_value_path)
-                        key_mappings[_key_path] = current_values
-            elif current_path:  # don't support empty root paths
-                key_mappings[current_path] = [current_path] * len(values)
-
-    return values, key_mappings
-
-
-def consensus_dict(
-    dict_values: List[dict],
-    consensus_settings: ConsensusSettings,
-    scorer: SimilarityScorer,
-    parent_valid_frac: float = 1.0,
-    llm_consensus_fn: Optional[LlmConsensusFn] = None,
-    weights: Optional[List[float]] = None,
-) -> Tuple[dict, Dict[str, Any]]:
-    """Field-by-field consensus. Returns (merged_dict, per-field confidences)."""
-    seen: set = set()
-    all_keys = [k for d in dict_values for k in d.keys() if k not in seen and not seen.add(k)]
-
-    result: dict = {}
-    confs: Dict[str, Any] = {}
-
-    for key in all_keys:
-        # reasoning___/source___ fields are skipped entirely (:1287-1294).
-        if any(prefix in key for prefix in SPECIAL_FIELD_PREFIXES):
-            continue
-        sub_vals = [d.get(key, None) for d in dict_values]
-        val, conf = consensus_values(
-            sub_vals,
-            consensus_settings,
-            scorer,
-            parent_valid_frac=parent_valid_frac,
-            llm_consensus_fn=llm_consensus_fn,
-            weights=weights,
+    if uniform and head is dict:
+        return _descend_keys(
+            values, scorer, min_support_ratio, max_novelty_ratio, current_path, reference_idx
         )
-        result[key] = val
-        confs[key] = conf
-
-    return (result, confs)
-
-
-def consensus_list(
-    list_values: List[List[Any]],
-    consensus_settings: ConsensusSettings,
-    scorer: SimilarityScorer,
-    parent_valid_frac: float = 1.0,
-    llm_consensus_fn: Optional[LlmConsensusFn] = None,
-    weights: Optional[List[float]] = None,
-) -> Tuple[List[Any], List[Any]]:
-    """Element-wise consensus across aligned lists (position i votes with position i)."""
-    if not list_values:
-        return ([], [])
-
-    non_empty_list_values = [lst for lst in list_values if lst]
-    if not non_empty_list_values:
-        return ([], [])
-
-    lengths = [len(lst) for lst in list_values]
-    maximum_len = max(lengths)
-    if maximum_len == 0:
-        return ([], [])
-
-    final_list = []
-    confidences = []
-    for i in range(maximum_len):
-        items = [(model_list[i] if i < len(model_list) else None) for model_list in list_values]
-        val_i, conf_i = consensus_values(
-            items,
-            consensus_settings,
-            scorer,
-            parent_valid_frac=parent_valid_frac,
-            llm_consensus_fn=llm_consensus_fn,
-            weights=weights,
+    if uniform and head is list:
+        return _descend_positions(
+            values, scorer, min_support_ratio, max_novelty_ratio, current_path, reference_idx
         )
-        final_list.append(val_i)
-        confidences.append(conf_i)
 
-    return final_list, confidences
+    # Scalars and mixed-type levels pass through untouched; a sample maps to the
+    # path iff it contributed a value (the designated reference always does).
+    return values, {
+        current_path: [
+            current_path if (v is not None or i == reference_idx) else None
+            for i, v in enumerate(values)
+        ]
+    }
+
+
+def _subset(
+    values: List[Any], weights: Optional[List[float]], keep: Callable[[Any], bool]
+) -> Tuple[List[Any], Optional[List[float]]]:
+    kept = [v for v in values if keep(v)]
+    kept_w = [w for v, w in zip(values, weights) if keep(v)] if weights else None
+    return kept, kept_w
 
 
 def consensus_values(
@@ -244,78 +189,111 @@ def consensus_values(
 ) -> Tuple[Any, Union[float, List[Any], Dict[str, Any]]]:
     """Type-directed consensus dispatcher. Returns (value, confidence-structure)."""
     if not values:
-        return (None, parent_valid_frac)
-
-    non_none_values = [v for v in values if v is not None]
-    if not non_none_values:
-        return (None, 0.0)
+        return None, parent_valid_frac
+    present = [v for v in values if v is not None]
+    if not present:
+        return None, 0.0
 
     # Enum-like str/bool (every value under 3 words) => voting.
-    if isinstance(non_none_values[0], (str, bool)):
-        values_as_strings = [str(v).strip() for v in non_none_values]
-        is_enum_like = all(len(v.split()) < 3 for v in values_as_strings)
-        if is_enum_like:
-            return voting_consensus(
-                values, consensus_settings, parent_valid_frac=parent_valid_frac, weights=weights
+    if isinstance(present[0], (str, bool)) and all(
+        len(str(v).strip().split()) < 3 for v in present
+    ):
+        return voting_consensus(
+            values, consensus_settings, parent_valid_frac=parent_valid_frac, weights=weights
+        )
+
+    for shape, handler in ((dict, consensus_dict), (list, consensus_list)):
+        if isinstance(present[0], shape):
+            kept, kept_w = _subset(values, weights, lambda v: isinstance(v, shape))
+            return handler(
+                kept,
+                consensus_settings,
+                scorer,
+                parent_valid_frac=parent_valid_frac * len(kept) / len(values),
+                llm_consensus_fn=llm_consensus_fn,
+                weights=kept_w,
             )
 
-    if isinstance(non_none_values[0], dict):
-        dicts_only = [v for v in values if isinstance(v, dict)]
-        dict_weights = (
-            [w for v, w in zip(values, weights) if isinstance(v, dict)] if weights else None
-        )
-        parent_valid_frac *= len(dicts_only) / len(values)
-        return consensus_dict(
-            dicts_only,
-            consensus_settings,
-            scorer,
-            parent_valid_frac=parent_valid_frac,
-            llm_consensus_fn=llm_consensus_fn,
-            weights=dict_weights,
-        )
-
-    if isinstance(non_none_values[0], list):
-        lists_only = [v for v in values if isinstance(v, list)]
-        list_weights = (
-            [w for v, w in zip(values, weights) if isinstance(v, list)] if weights else None
-        )
-        parent_valid_frac *= len(lists_only) / len(values)
-        return consensus_list(
-            lists_only,
-            consensus_settings,
-            scorer,
-            parent_valid_frac=parent_valid_frac,
-            llm_consensus_fn=llm_consensus_fn,
-            weights=list_weights,
-        )
-
-    parent_valid_frac *= len(non_none_values) / len(values)
-    nn_weights = (
-        [w for v, w in zip(values, weights) if v is not None] if weights else None
-    )
+    kept_w = _subset(values, weights, lambda v: v is not None)[1]
     return consensus_as_primitive(
-        non_none_values,
+        present,
         consensus_settings,
         scorer,
-        parent_valid_frac=parent_valid_frac,
+        parent_valid_frac=parent_valid_frac * len(present) / len(values),
         llm_consensus_fn=llm_consensus_fn,
-        weights=nn_weights,
+        weights=kept_w,
     )
+
+
+def consensus_dict(
+    dict_values: List[dict],
+    consensus_settings: ConsensusSettings,
+    scorer: SimilarityScorer,
+    parent_valid_frac: float = 1.0,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    weights: Optional[List[float]] = None,
+) -> Tuple[dict, Dict[str, Any]]:
+    """Field-by-field consensus. Returns (merged_dict, per-field confidences).
+
+    Keys run in first-seen order across samples; reasoning___/source___ fields
+    are skipped entirely (:1287-1294)."""
+    merged: dict = {}
+    confidences: Dict[str, Any] = {}
+    for key in dict.fromkeys(k for d in dict_values for k in d):
+        if any(marker in key for marker in SPECIAL_FIELD_PREFIXES):
+            continue
+        merged[key], confidences[key] = consensus_values(
+            [d.get(key) for d in dict_values],
+            consensus_settings,
+            scorer,
+            parent_valid_frac=parent_valid_frac,
+            llm_consensus_fn=llm_consensus_fn,
+            weights=weights,
+        )
+    return merged, confidences
+
+
+def consensus_list(
+    list_values: List[List[Any]],
+    consensus_settings: ConsensusSettings,
+    scorer: SimilarityScorer,
+    parent_valid_frac: float = 1.0,
+    llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    weights: Optional[List[float]] = None,
+) -> Tuple[List[Any], List[Any]]:
+    """Element-wise consensus across aligned lists (position i votes with
+    position i; short lists contribute None)."""
+    width = max((len(lst) for lst in list_values), default=0)
+    out: List[Any] = []
+    confidences: List[Any] = []
+    for col in range(width):
+        value, conf = consensus_values(
+            [lst[col] if col < len(lst) else None for lst in list_values],
+            consensus_settings,
+            scorer,
+            parent_valid_frac=parent_valid_frac,
+            llm_consensus_fn=llm_consensus_fn,
+            weights=weights,
+        )
+        out.append(value)
+        confidences.append(conf)
+    return out, confidences
 
 
 def intermediary_consensus_cleanup(obj):
-    """Strip empty strings/dicts/lists recursively, collapsing emptied containers
-    to None. Parity: ``intermediary_consensus_cleanup``,
+    """Strip empty strings/dicts/lists recursively, collapsing emptied
+    containers to None. Spec: ``intermediary_consensus_cleanup``,
     `/root/reference/k_llms/utils/consensus_utils.py:1355-1370`."""
     if isinstance(obj, dict):
-        new_obj = {
-            k: w for k, v in obj.items() if (w := intermediary_consensus_cleanup(v)) is not None
+        kept = {
+            k: v
+            for k, v in ((k, intermediary_consensus_cleanup(v)) for k, v in obj.items())
+            if v is not None
         }
-        return new_obj if new_obj else None
+        return kept or None
     if isinstance(obj, (list, tuple)):
-        new_obj = [w for v in obj if (w := intermediary_consensus_cleanup(v)) is not None]
-        return new_obj if new_obj else None
+        kept = [v for v in map(intermediary_consensus_cleanup, obj) if v is not None]
+        return kept or None
     if isinstance(obj, str):
-        stripped = obj.strip()
-        return stripped if stripped else None
+        return obj.strip() or None
     return obj

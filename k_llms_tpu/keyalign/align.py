@@ -1,98 +1,81 @@
 """Key-based recursive alignment engine.
 
-Parity target: `/root/reference/k_llms/utils/key_based_alignment.py` —
-``_get_key_tuple`` :47-68 (NB: matches on RAW values; only key *selection* uses
-normalization), ``_align_lists_by_key`` :71-151 (order from the longest source,
-then remaining keys sorted), the recursive core :156-347 (zip fallback for
-scalar lists :324-345), per-source view projection :474-516, and the public
+Behavioral spec: `/root/reference/k_llms/utils/key_based_alignment.py` —
+``_get_key_tuple`` :47-68 (matches on RAW values; only key *selection*
+normalizes), ``_align_lists_by_key`` :71-151 (row order from the longest
+source, then remaining keys sorted), the recursive merge :156-347 (zip fallback
+for scalar lists :324-345), per-source view projection :474-516, and the public
 ``recursive_align`` :350-431 whose signature matches the similarity aligner so
-it can swap in at the documented point (`consolidation.py:22`).
+it can swap in at the documented point (`consolidation.py:22`). Pinned by the
+differential oracle in ``tests/test_keyalign.py``.
+
+Design notes: the two row producers (key-tuple alignment and positional zip)
+emit a common (row_values, row_positions) plan consumed by one shared merge
+loop; source catalogs are first-occurrence dicts rather than parallel
+index/set bookkeeping; key selection catches only ``ValueError`` (a missing
+key is expected — anything else is a real bug and surfaces).
 """
 
 from __future__ import annotations
 
 import logging
 from copy import deepcopy
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from .fuzzy import select_best_keys_with_fuzzy_fallback
-from .selection import CascadeConfig, select_best_keys
+from .selection import CascadeConfig, _walk, select_best_keys
 
 logger = logging.getLogger(__name__)
 
+PathMap = Dict[str, List[Optional[str]]]
+RowPlan = Iterable[Tuple[List[Any], List[Optional[int]]]]
+
 
 def _get_key_tuple(obj: Dict[str, Any], paths: Tuple[str, ...]) -> Optional[Tuple[Any, ...]]:
-    """Raw (un-normalized) key tuple; None if any path fails to resolve."""
-    values = []
-    for path in paths:
-        current: Any = obj
-        for part in path.split("."):
-            if isinstance(current, dict) and part in current:
-                current = current[part]
-            else:
-                return None
-        if current is None or isinstance(current, (dict, list)):
-            return None
-        values.append(current)
-    return tuple(values)
+    """Raw (un-normalized) key tuple; None if any component is missing, None,
+    or a container."""
+    parts = [_walk(obj, p) for p in paths]
+    if any(v is None or isinstance(v, (dict, list)) for v in parts):
+        return None
+    return tuple(parts)
+
+
+def _catalog(source: Any, key_paths: Tuple[str, ...]) -> Dict[Tuple[Any, ...], int]:
+    """Key tuple -> first occurrence index for one source list (non-lists and
+    non-dict items contribute nothing)."""
+    out: Dict[Tuple[Any, ...], int] = {}
+    if isinstance(source, list):
+        for i, item in enumerate(source):
+            if isinstance(item, dict):
+                key = _get_key_tuple(item, key_paths)
+                if key is not None:
+                    out.setdefault(key, i)
+    return out
 
 
 def _align_lists_by_key(
-    lists_to_align: Sequence[Optional[List[Dict[str, Any]]]],
-    key_paths: Tuple[str, ...],
+    sources: Sequence[Optional[List[Dict[str, Any]]]], key_paths: Tuple[str, ...]
 ) -> Tuple[List[List[Optional[Dict[str, Any]]]], List[List[Optional[int]]]]:
     """Rows = key tuples (ordered by the longest source list, then sorted
     leftovers); columns = sources. Returns (aligned_rows, original_indices)."""
-    if not any(lists_to_align):
+    if not any(sources):
         return [], []
 
-    all_key_tuples: set = set()
-    indexes: List[Dict[Tuple[Any, ...], int]] = []
-    for source_list in lists_to_align:
-        mapping: Dict[Tuple[Any, ...], int] = {}
-        if isinstance(source_list, list):
-            for i, item in enumerate(source_list):
-                if isinstance(item, dict):
-                    key_tuple = _get_key_tuple(item, key_paths)
-                    if key_tuple is not None and key_tuple not in mapping:
-                        mapping[key_tuple] = i
-                        all_key_tuples.add(key_tuple)
-        indexes.append(mapping)
+    catalogs = [_catalog(src, key_paths) for src in sources]
+    anchor = max(
+        range(len(sources)),
+        key=lambda i: len(sources[i]) if isinstance(sources[i], list) else 0,
+    )
+    order = list(catalogs[anchor])  # the anchor's first-occurrence order
+    order += sorted({k for c in catalogs for k in c} - set(order))
 
-    def _safe_len(source_list) -> int:
-        return len(source_list) if isinstance(source_list, list) else 0
-
-    best_source_idx = max(range(len(lists_to_align)), key=lambda i: _safe_len(lists_to_align[i]))
-    best_source_list = lists_to_align[best_source_idx]
-
-    ordered_keys: List[Tuple[Any, ...]] = []
-    seen_keys: set = set()
-    if isinstance(best_source_list, list):
-        for item in best_source_list:
-            if isinstance(item, dict):
-                key_tuple = _get_key_tuple(item, key_paths)
-                if key_tuple is not None and key_tuple not in seen_keys:
-                    ordered_keys.append(key_tuple)
-                    seen_keys.add(key_tuple)
-    ordered_keys.extend(sorted(all_key_tuples - seen_keys))
-
-    aligned_rows: List[List[Optional[Dict[str, Any]]]] = []
-    original_indices: List[List[Optional[int]]] = []
-    for key_tuple in ordered_keys:
-        row: List[Optional[Dict[str, Any]]] = []
-        indices_row: List[Optional[int]] = []
-        for source_idx, source_list in enumerate(lists_to_align):
-            original_idx = indexes[source_idx].get(key_tuple)
-            if original_idx is not None and isinstance(source_list, list):
-                row.append(source_list[original_idx])
-                indices_row.append(original_idx)
-            else:
-                row.append(None)
-                indices_row.append(None)
-        aligned_rows.append(row)
-        original_indices.append(indices_row)
-
-    return aligned_rows, original_indices
+    rows: List[List[Optional[Dict[str, Any]]]] = []
+    positions: List[List[Optional[int]]] = []
+    for key in order:
+        where = [c.get(key) for c in catalogs]
+        rows.append([src[i] if i is not None else None for i, src in zip(where, sources)])
+        positions.append(where)
+    return rows, positions
 
 
 def _select_key_paths(
@@ -100,198 +83,182 @@ def _select_key_paths(
 ) -> Optional[Tuple[str, ...]]:
     """Standard selection (composite-aware) first; fuzzy preferred when it
     improves stability; fuzzy-only as last resort."""
-    dummy_extractions = [{"items": lst} for lst in lists]
+    wrapped = [{"items": lst} for lst in lists]
+
+    def fuzzy_comparison():
+        return select_best_keys_with_fuzzy_fallback(
+            wrapped,
+            cascade_cfg=cascade_cfg,
+            list_key="items",
+            fuzzy_numeric_round_decimals=2,
+            enable_fuzzy_fallback=True,
+            prefer_fuzzy_if_better=True,
+        )
+
     try:
-        result = select_best_keys(dummy_extractions, list_key="items", cascade_cfg=cascade_cfg)
-        use_composite = (
-            result.best_composite is not None
-            and result.best_composite.score_tuple > result.best_single.score_tuple
-        )
-        standard_paths = (
-            result.best_composite.path if use_composite else result.best_single.path
-        )
-        try:
-            comp = select_best_keys_with_fuzzy_fallback(
-                dummy_extractions,
-                cascade_cfg=cascade_cfg,
-                list_key="items",
-                fuzzy_numeric_round_decimals=2,
-                enable_fuzzy_fallback=True,
-                prefer_fuzzy_if_better=True,
-            )
-            if comp.chosen == "fuzzy" and comp.fuzzy_best is not None:
-                logger.debug("key-select: fuzzy path %s", comp.fuzzy_best.path)
-                return comp.fuzzy_best.path
-        except Exception:
-            pass
-        logger.debug("key-select: standard path %s", standard_paths)
-        return standard_paths
+        picked = select_best_keys(wrapped, list_key="items", cascade_cfg=cascade_cfg)
     except ValueError:
+        # No exact key at all — fuzzy canonicalization is the last resort.
         try:
-            comp = select_best_keys_with_fuzzy_fallback(
-                dummy_extractions,
-                cascade_cfg=cascade_cfg,
-                list_key="items",
-                fuzzy_numeric_round_decimals=2,
-                enable_fuzzy_fallback=True,
-                prefer_fuzzy_if_better=True,
-            )
-            chosen = comp.fuzzy_best if comp.chosen == "fuzzy" else comp.normal_best
-            return chosen.path if chosen is not None else None
-        except Exception:
+            comparison = fuzzy_comparison()
+        except ValueError:
             logger.debug("key-select: no key found")
             return None
+        winner = (
+            comparison.fuzzy_best if comparison.chosen == "fuzzy" else comparison.normal_best
+        )
+        return winner.path if winner is not None else None
+
+    exact = picked.best_single
+    if (
+        picked.best_composite is not None
+        and picked.best_composite.score_tuple > exact.score_tuple
+    ):
+        exact = picked.best_composite
+    try:
+        comparison = fuzzy_comparison()
+        if comparison.chosen == "fuzzy" and comparison.fuzzy_best is not None:
+            logger.debug("key-select: fuzzy path %s", comparison.fuzzy_best.path)
+            return comparison.fuzzy_best.path
+    except ValueError:
+        pass
+    logger.debug("key-select: standard path %s", exact.path)
+    return exact.path
 
 
-def _compute_key_aligned_structure(
+def _merge_rows(
+    plan: RowPlan, origins: Sequence[Optional[str]], cascade_cfg: CascadeConfig
+) -> Tuple[List[Any], PathMap]:
+    """Merge each planned row and collect its mapping under the row index."""
+    merged: List[Any] = []
+    mapping: PathMap = {}
+    for i, (row, where) in enumerate(plan):
+        row_origins = [
+            None if (p is None or q is None) else (f"{p}.{q}" if p else str(q))
+            for p, q in zip(origins, where)
+        ]
+        item, sub = _merge_column(row, row_origins, cascade_cfg)
+        merged.append(item)
+        for leaf, srcs in sub.items():
+            mapping[f"{i}.{leaf}" if leaf else str(i)] = srcs
+    return merged, mapping
+
+
+def _merge_column(
     values: Sequence[Any],
-    original_paths: Sequence[Optional[str]],
+    origins: Sequence[Optional[str]],
     cascade_cfg: CascadeConfig,
-) -> Tuple[Any, Dict[str, List[Optional[str]]]]:
+) -> Tuple[Any, PathMap]:
     """One merged aligned structure + mapping from aligned paths to per-source
     original paths."""
-    if not values or all(v is None for v in values):
+    present = [v for v in values if v is not None]
+    if not present:
         return None, {}
+    head = type(present[0])
 
-    non_nulls = [v for v in values if v is not None]
-    if not non_nulls:
-        return None, {}
+    # Scalars / mixed types: first non-null value represents the column, and
+    # every source keeps its inherited path (contributing or not).
+    if head not in (dict, list) or not all(isinstance(v, head) for v in present):
+        return deepcopy(present[0]), {"": list(origins)}
 
-    first_type = type(non_nulls[0])
-    is_same_type = all(isinstance(v, first_type) for v in non_nulls)
-    key_mappings: Dict[str, List[Optional[str]]] = {}
-
-    # Scalars / mixed types: first non-null value represents the column.
-    if not is_same_type or first_type not in (dict, list):
-        key_mappings[""] = list(original_paths)
-        return deepcopy(non_nulls[0]), key_mappings
-
-    if first_type is dict:
-        dicts = [v if isinstance(v, dict) else {} for v in values]
-        all_keys = sorted(set(key for d in dicts for key in d.keys()))
-
-        aligned_dict: Dict[str, Any] = {}
-        for key in all_keys:
-            values_for_key = [d.get(key) for d in dicts]
-            original_paths_for_key = [
-                (f"{p}.{key}" if p else key) if p is not None else None
-                for p in original_paths
+    if head is dict:
+        shells = [v if isinstance(v, dict) else {} for v in values]
+        merged: Dict[str, Any] = {}
+        mapping: PathMap = {}
+        for key in sorted({k for d in shells for k in d}):
+            child_origins = [
+                None if p is None else (f"{p}.{key}" if p else key) for p in origins
             ]
-            aligned_value, sub_mapping = _compute_key_aligned_structure(
-                values_for_key, original_paths_for_key, cascade_cfg
+            merged[key], sub = _merge_column(
+                [d.get(key) for d in shells], child_origins, cascade_cfg
             )
-            aligned_dict[key] = aligned_value
-            for sub_key, paths in sub_mapping.items():
-                key_mappings[f"{key}.{sub_key}" if sub_key else key] = paths
-        return aligned_dict, key_mappings
+            for leaf, srcs in sub.items():
+                mapping[f"{key}.{leaf}" if leaf else key] = srcs
+        return merged, mapping
 
-    # first_type is list
-    lists = [v if isinstance(v, list) else [] for v in values]
-    is_list_of_dicts = all(
-        all(isinstance(item, dict) for item in lst) for lst in lists if lst
-    )
-
-    if is_list_of_dicts:
-        key_paths = _select_key_paths(lists, cascade_cfg)
+    rows = [v if isinstance(v, list) else [] for v in values]
+    uniform_dicts = all(isinstance(item, dict) for lst in rows if lst for item in lst)
+    if uniform_dicts:
+        key_paths = _select_key_paths(rows, cascade_cfg)
         if key_paths:
-            aligned_rows, original_indices = _align_lists_by_key(lists, key_paths)
-            aligned_list = []
-            for i, row in enumerate(aligned_rows):
-                original_paths_for_row = [
-                    (
-                        (f"{p}.{original_indices[i][j]}" if p else str(original_indices[i][j]))
-                        if (p is not None and original_indices[i][j] is not None)
-                        else None
-                    )
-                    for j, p in enumerate(original_paths)
-                ]
-                aligned_item, sub_mapping = _compute_key_aligned_structure(
-                    row, original_paths_for_row, cascade_cfg
-                )
-                aligned_list.append(aligned_item)
-                for sub_key, paths in sub_mapping.items():
-                    key_mappings[f"{i}.{sub_key}" if sub_key else str(i)] = paths
-            return aligned_list, key_mappings
+            aligned, positions = _align_lists_by_key(rows, key_paths)
+            return _merge_rows(zip(aligned, positions), origins, cascade_cfg)
 
-    # Zip fallback for scalar lists / failed key selection.
+    # Positional zip for scalar lists / failed key selection. NB the position
+    # gate reads len(values[j]) — the raw value, not the list-coerced one —
+    # faithfully to the spec (:332).
     logger.debug("key-align: zip fallback")
-    aligned_list = []
-    max_len = max(len(lst) for lst in lists) if lists else 0
-    for i in range(max_len):
-        row = [lst[i] if i < len(lst) else None for lst in lists]
-        original_paths_for_row = [
-            ((f"{p}.{i}" if p else str(i)) if i < len(values[j]) else None)
-            if p is not None
-            else None
-            for j, p in enumerate(original_paths)
-        ]
-        aligned_item, sub_mapping = _compute_key_aligned_structure(
-            row, original_paths_for_row, cascade_cfg
+    width = max((len(lst) for lst in rows), default=0)
+    plan = (
+        (
+            [lst[i] if i < len(lst) else None for lst in rows],
+            [
+                # len(values[j]) must stay unevaluated for non-contributing
+                # sources (the spec only touches it under `p is not None`).
+                None
+                if origins[j] is None
+                else (i if i < len(values[j]) else None)
+                for j in range(len(values))
+            ],
         )
-        aligned_list.append(aligned_item)
-        for sub_key, paths in sub_mapping.items():
-            key_mappings[f"{i}.{sub_key}" if sub_key else str(i)] = paths
-    return aligned_list, key_mappings
+        for i in range(width)
+    )
+    return _merge_rows(plan, origins, cascade_cfg)
 
 
-def _get_value_by_path(obj: Any, path: Optional[str]) -> Any:
+def _lookup(root: Any, path: Optional[str]) -> Any:
     """Dot-path lookup with integer list indices; '' is the root."""
     if path is None:
         return None
-    if path == "":
-        return obj
-    cur = obj
+    node = root
     for token in path.split("."):
         if token == "":
             continue
         try:
-            idx = int(token)
+            i = int(token)
         except ValueError:
-            idx = None
-        if idx is not None:
-            if isinstance(cur, list) and 0 <= idx < len(cur):
-                cur = cur[idx]
-                continue
-            return None
-        if isinstance(cur, dict) and token in cur:
-            cur = cur[token]
+            i = None
+        if i is not None:
+            # Numeric tokens only ever index lists; a dict with a numeric
+            # string key is unreachable through them.
+            if not (isinstance(node, list) and 0 <= i < len(node)):
+                return None
+            node = node[i]
+        elif isinstance(node, dict) and token in node:
+            node = node[token]
         else:
             return None
-    return cur
+    return node
 
 
-def _materialize_source_view(
+def _project(
     aligned_node: Any,
-    key_mappings: Dict[str, List[Optional[str]]],
+    key_mappings: PathMap,
     source_idx: int,
-    current_path: str = "",
-    source_root: Optional[Dict[str, Any]] = None,
+    current_path: str,
+    source_root: Any,
 ) -> Any:
     """Project the merged structure back into one source's values via the
     path mappings (None where that source contributed nothing)."""
-    if source_root is None:
-        raise ValueError("source_root must be provided at the top-level call.")
-
     if isinstance(aligned_node, dict):
-        return {
-            k: _materialize_source_view(
-                v, key_mappings, source_idx, f"{current_path}.{k}" if current_path else k, source_root
-            )
-            for k, v in aligned_node.items()
-        }
+        items = aligned_node.items()
+    elif isinstance(aligned_node, list):
+        items = enumerate(aligned_node)
+    else:
+        routed = key_mappings.get(current_path)
+        if routed is not None and 0 <= source_idx < len(routed):
+            return _lookup(source_root, routed[source_idx])
+        return deepcopy(aligned_node)
 
-    if isinstance(aligned_node, list):
-        return [
-            _materialize_source_view(
-                v, key_mappings, source_idx, f"{current_path}.{i}" if current_path else str(i), source_root
-            )
-            for i, v in enumerate(aligned_node)
-        ]
+    def child(token):
+        return f"{current_path}.{token}" if current_path else str(token)
 
-    mapped_paths = key_mappings.get(current_path)
-    if mapped_paths is not None and 0 <= source_idx < len(mapped_paths):
-        return _get_value_by_path(source_root, mapped_paths[source_idx])
-    return deepcopy(aligned_node)
+    projected = (
+        (k, _project(v, key_mappings, source_idx, child(k), source_root)) for k, v in items
+    )
+    if isinstance(aligned_node, dict):
+        return dict(projected)
+    return [v for _, v in projected]
 
 
 def recursive_align(
@@ -303,7 +270,7 @@ def recursive_align(
     reference_idx: Optional[int] = None,
     min_uniqueness: Optional[float] = None,
     min_coverage: Optional[float] = None,
-) -> Tuple[Sequence[Any], Dict[str, List[Optional[str]]]]:
+) -> Tuple[Sequence[Any], PathMap]:
     """Key-based recursive alignment with the similarity aligner's API.
 
     ``string_similarity_method``/``max_novelty_ratio``/``reference_idx`` are
@@ -312,59 +279,38 @@ def recursive_align(
     if not values:
         return list(values), {}
     if all(v is None for v in values):
-        return list(values), {current_path: [current_path for _ in values]}
+        return list(values), {current_path: [current_path] * len(values)}
 
-    non_nulls = [v for v in values if v is not None]
-    if not non_nulls:
-        return list(values), {}
-
-    eff_min_coverage = min_coverage if min_coverage is not None else min_support_ratio
-    eff_min_uniqueness = min_uniqueness if min_uniqueness is not None else 0.5
     cascade_cfg = CascadeConfig(
-        min_coverage=eff_min_coverage, min_uniqueness=eff_min_uniqueness
+        min_coverage=min_support_ratio if min_coverage is None else min_coverage,
+        min_uniqueness=0.5 if min_uniqueness is None else min_uniqueness,
     )
 
-    original_paths: List[Optional[str]] = [current_path for _ in values]
-    aligned_data, raw_key_mappings = _compute_key_aligned_structure(
-        values, original_paths, cascade_cfg
-    )
+    merged, mapping = _merge_column(values, [current_path] * len(values), cascade_cfg)
 
-    per_source_outputs: List[Any] = []
-    for i, src_root in enumerate(values):
-        if isinstance(src_root, dict):
-            materialized_root: Dict[str, Any] = src_root
-        elif isinstance(src_root, list):
-            materialized_root = {"items": src_root}
-            # NB: reference parity — the "items." rewrite mutates the shared
-            # mapping inside the source loop (:398-400), so list-valued roots
-            # with multiple sources double-prefix. The wired swap point only
-            # ever passes dict roots, where this path is never taken.
-            if raw_key_mappings:
-                raw_key_mappings = {
-                    (f"items.{k}" if k else "items"): v for k, v in raw_key_mappings.items()
-                }
+    views: List[Any] = []
+    for idx, root in enumerate(values):
+        if isinstance(root, dict):
+            wrapped: Any = root
+        elif isinstance(root, list):
+            wrapped = {"items": root}
+            # NB spec parity: the "items." rewrite mutates the shared mapping
+            # inside the source loop (:398-400), so list-valued roots with
+            # multiple sources double-prefix. The wired swap point only ever
+            # passes dict roots, where this path is never taken.
+            if mapping:
+                mapping = {(f"items.{k}" if k else "items"): v for k, v in mapping.items()}
         else:
-            materialized_root = {}
-        per_source_outputs.append(
-            _materialize_source_view(
-                aligned_node=aligned_data,
-                key_mappings=raw_key_mappings,
-                source_idx=i,
-                current_path="",
-                source_root=materialized_root,
-            )
+            wrapped = {}
+        views.append(
+            _project(merged, mapping, idx, current_path="", source_root=wrapped)
         )
 
-    if current_path:
-        prefixed: Dict[str, List[Optional[str]]] = {}
-        for key, paths in raw_key_mappings.items():
-            pref_key = f"{current_path}.{key}" if key else current_path
-            pref_paths: List[Optional[str]] = []
-            for p in paths:
-                if p is None or p == "":
-                    pref_paths.append(current_path if current_path else None)
-                else:
-                    pref_paths.append(f"{current_path}.{p}" if current_path else p)
-            prefixed[pref_key] = pref_paths
-        return per_source_outputs, prefixed
-    return per_source_outputs, raw_key_mappings
+    if not current_path:
+        return views, mapping
+    rebased: PathMap = {}
+    for key, paths in mapping.items():
+        rebased[f"{current_path}.{key}" if key else current_path] = [
+            current_path if not p else f"{current_path}.{p}" for p in paths
+        ]
+    return views, rebased

@@ -1,48 +1,40 @@
 """Fuzzy key selection: canonicalized scalars (rounded numerics, normalized
 strings), preferred over standard selection iff stability strictly improves.
 
-Parity target: `/root/reference/k_llms/utils/fuzzy_key_selection.py` —
-canonicalization :37-52, fuzzy cascade :100-157 (here the shared parametrized
-funnel from selection.py), comparison/decision :175-232.
+Behavioral spec: `/root/reference/k_llms/utils/fuzzy_key_selection.py` —
+canonicalization :37-52, fuzzy cascade :100-157 (served here by the shared
+parametrized funnel in selection.py), comparison/decision :175-232 — pinned by
+the differential oracle in ``tests/test_keyalign.py``.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from functools import partial
 from typing import Any, Dict, List, Optional
 
-from pydantic import BaseModel, ConfigDict
-
-from .selection import (
-    CascadeConfig,
-    KeyMetrics,
-    cascade_select_keys,
-    discover_scalar_paths,
-    normalize_scalar,
-    select_best_keys,
-    stability_tuple,
-)
+from . import selection
+from .selection import CascadeConfig, KeyMetrics
 
 
 def canonicalize_scalar(value: Any, numeric_round_decimals: int = 2) -> Any:
     """Numbers rounded to N decimals; strings lower/trim/collapse; rest as-is."""
     if isinstance(value, (int, float)) and not isinstance(value, bool):
         try:
-            return round(float(value), numeric_round_decimals)
+            quantized = round(float(value), numeric_round_decimals)
         except Exception:
-            return value
-    if isinstance(value, str):
-        return normalize_scalar(value)
-    return value
+            quantized = value
+        return quantized
+    return selection.normalize_scalar(value)
 
 
-class SelectionComparison(BaseModel):
+@dataclass(frozen=True)
+class SelectionComparison:
     """Which strategy won: "normal" | "fuzzy"."""
 
-    model_config = ConfigDict(frozen=True)
-
-    normal_best: Optional[KeyMetrics]
-    fuzzy_best: Optional[KeyMetrics]
-    chosen: str
+    normal_best: Optional[KeyMetrics] = None
+    fuzzy_best: Optional[KeyMetrics] = None
+    chosen: str = "normal"
 
 
 def select_best_keys_with_fuzzy_fallback(
@@ -53,42 +45,44 @@ def select_best_keys_with_fuzzy_fallback(
     enable_fuzzy_fallback: bool = True,
     prefer_fuzzy_if_better: bool = True,
 ) -> SelectionComparison:
-    normal_best: Optional[KeyMetrics] = None
-    try:
-        normal_best = select_best_keys(
+    """Run both selectors and pick one: exact wins unless fuzzy exists and
+    strictly improves the stability tuple (or exact failed entirely)."""
+
+    def attempt(run):
+        try:
+            return run()
+        except ValueError:
+            return None
+
+    exact = attempt(
+        lambda: selection.select_best_keys(
             extractions, cascade_cfg=cascade_cfg, list_key=list_key
         ).best_single
-    except ValueError:
-        normal_best = None
+    )
 
-    fuzzy_best: Optional[KeyMetrics] = None
+    fuzzy = None
     if enable_fuzzy_fallback:
-        candidates = discover_scalar_paths(extractions, list_key=list_key)
-        if candidates:
-            try:
-                fuzzy_best = cascade_select_keys(
+        paths = selection.discover_scalar_paths(extractions, list_key=list_key)
+        if paths:
+            fuzzy = attempt(
+                lambda: selection.cascade_select_keys(
                     extractions,
-                    candidates,
+                    paths,
                     cascade_cfg,
                     list_key=list_key,
-                    canonicalize=lambda v: canonicalize_scalar(
-                        v, fuzzy_numeric_round_decimals
+                    canonicalize=partial(
+                        canonicalize_scalar, numeric_round_decimals=fuzzy_numeric_round_decimals
                     ),
                 ).final_best
-            except ValueError:
-                fuzzy_best = None
+            )
 
-    if normal_best is None and fuzzy_best is None:
+    if exact is None and fuzzy is None:
         raise ValueError("No keys pass Stage 0 (normal or fuzzy)")
-
-    if normal_best is not None and (not enable_fuzzy_fallback or fuzzy_best is None):
-        return SelectionComparison(normal_best=normal_best, fuzzy_best=None, chosen="normal")
-
-    if normal_best is None:
-        return SelectionComparison(normal_best=None, fuzzy_best=fuzzy_best, chosen="fuzzy")
-
-    if prefer_fuzzy_if_better and stability_tuple(fuzzy_best) > stability_tuple(normal_best):
-        return SelectionComparison(
-            normal_best=normal_best, fuzzy_best=fuzzy_best, chosen="fuzzy"
-        )
-    return SelectionComparison(normal_best=normal_best, fuzzy_best=fuzzy_best, chosen="normal")
+    if exact is None:
+        return SelectionComparison(fuzzy_best=fuzzy, chosen="fuzzy")
+    if fuzzy is None:
+        return SelectionComparison(normal_best=exact)
+    take_fuzzy = prefer_fuzzy_if_better and fuzzy.stability > exact.stability
+    return SelectionComparison(
+        normal_best=exact, fuzzy_best=fuzzy, chosen="fuzzy" if take_fuzzy else "normal"
+    )

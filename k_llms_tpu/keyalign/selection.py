@@ -1,12 +1,18 @@
 """Join-key discovery and cascade selection.
 
-Parity target: `/root/reference/k_llms/utils/key_selection.py` — path discovery
-:100-121, metrics :154-214 (coverage / uniqueness / pairwise-Jaccard stability /
-support histogram with the 9-component lexicographic score), the 4-stage cascade
-funnel :310-367, and greedy + brute-force composite search :412-437.
+Behavioral spec: `/root/reference/k_llms/utils/key_selection.py` — path
+discovery :100-121, metrics :154-214 (coverage / uniqueness / pairwise-Jaccard
+stability / support histogram feeding a 9-component lexicographic score), the
+4-stage cascade funnel :310-367, and greedy + brute-force composite search
+:412-437 — pinned by the differential oracle in ``tests/test_keyalign.py``.
 
-One cascade implementation serves both the standard and fuzzy selectors via a
-``canonicalize`` hook (the reference duplicates the funnel).
+Design differences from the reference: single and composite keys share ONE
+tuple-valued projection (a single key is a 1-tuple — the score depends on
+values only through equality, so the wrapping is invisible); metrics are a
+frozen dataclass whose ranking tuples are derived properties; and the funnel is
+data-driven (a list of (rank, cap) stages folded over the candidate pool). One
+cascade serves both the standard and fuzzy selectors via a ``canonicalize``
+hook (the reference duplicates the funnel).
 """
 
 from __future__ import annotations
@@ -14,24 +20,23 @@ from __future__ import annotations
 import math
 import re
 from collections import Counter
+from dataclasses import dataclass
 from itertools import combinations
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
-
-from pydantic import BaseModel, ConfigDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 JSONPath = str
 
-# Configurable record-list keys checked before auto-detection.
+# Record-container keys probed before falling back to auto-detection.
 RECORD_LIST_KEYS: List[str] = ["products"]
 
-_WS = re.compile(r"\s+")
+_SQUEEZE = re.compile(r"\s+")
 
 
 def normalize_scalar(value: Any) -> Any:
     """Lowercase + collapse whitespace for strings; other scalars pass through."""
-    if isinstance(value, str):
-        return _WS.sub(" ", value.strip().lower())
-    return value
+    if not isinstance(value, str):
+        return value
+    return _SQUEEZE.sub(" ", value.strip().lower())
 
 
 def iter_records(
@@ -39,242 +44,177 @@ def iter_records(
 ) -> List[Dict[str, Any]]:
     """Record dicts from ``list_key``, else RECORD_LIST_KEYS, else every
     list-of-dicts value in order."""
-    records: List[Dict[str, Any]] = []
+
+    def dicts_in(container: Any) -> Iterator[Dict[str, Any]]:
+        if isinstance(container, list):
+            yield from (x for x in container if isinstance(x, dict))
+
     if list_key is not None:
-        seq = extraction.get(list_key)
-        if isinstance(seq, list):
-            records.extend(item for item in seq if isinstance(item, dict))
-        return records
-
-    for candidate_key in RECORD_LIST_KEYS:
-        seq = extraction.get(candidate_key)
-        if isinstance(seq, list):
-            records.extend(item for item in seq if isinstance(item, dict))
-    if records:
-        return records
-
-    for value in extraction.values():
-        if isinstance(value, list):
-            records.extend(item for item in value if isinstance(item, dict))
-    return records
+        return list(dicts_in(extraction.get(list_key)))
+    named = [r for k in RECORD_LIST_KEYS for r in dicts_in(extraction.get(k))]
+    if named:
+        return named
+    return [r for v in extraction.values() for r in dicts_in(v)]
 
 
-def _resolve_path(record: Any, parts: List[str]) -> Tuple[bool, Any]:
-    cur = record
-    for token in parts:
-        if isinstance(cur, dict) and token in cur:
-            cur = cur[token]
-        else:
-            return False, None
-    return True, cur
+def _walk(record: Any, dotted: str) -> Any:
+    """Resolve a dot path inside nested dicts; a sentinel miss returns None
+    (scalar None and a miss are treated the same by every caller)."""
+    node = record
+    for step in dotted.split("."):
+        if not (isinstance(node, dict) and step in node):
+            return None
+        node = node[step]
+    return node
 
 
-def values_for_path(
+def project_key(
     extraction: Dict[str, Any],
-    path: JSONPath,
-    list_key: Optional[str] = None,
-    canonicalize: Callable[[Any], Any] = normalize_scalar,
-) -> List[Any]:
-    """Scalar values at a dot path across all records of one extraction."""
-    parts = path.split(".")
-    out: List[Any] = []
-    for record in iter_records(extraction, list_key=list_key):
-        if not isinstance(record, dict):
-            continue
-        ok, cur = _resolve_path(record, parts)
-        if ok and cur is not None and not isinstance(cur, (dict, list)):
-            out.append(canonicalize(cur))
-    return out
-
-
-def tuple_values_for_paths(
-    extraction: Dict[str, Any],
-    paths: List[JSONPath],
+    key: Tuple[JSONPath, ...],
     list_key: Optional[str] = None,
     canonicalize: Callable[[Any], Any] = normalize_scalar,
 ) -> List[Tuple[Any, ...]]:
-    """Composite-key tuples across records; records missing any component drop out."""
-    parts_list = [p.split(".") for p in paths]
-    out: List[Tuple[Any, ...]] = []
+    """Canonicalized key tuples across one extraction's records. A record drops
+    out when any component is missing, None, or a container."""
+    rows: List[Tuple[Any, ...]] = []
     for record in iter_records(extraction, list_key=list_key):
-        if not isinstance(record, dict):
+        parts = [_walk(record, p) for p in key]
+        if any(v is None or isinstance(v, (dict, list)) for v in parts):
             continue
-        components: List[Any] = []
-        for parts in parts_list:
-            ok, cur = _resolve_path(record, parts)
-            if not ok or cur is None or isinstance(cur, (dict, list)):
-                components = []
-                break
-            components.append(canonicalize(cur))
-        if components:
-            out.append(tuple(components))
-    return out
+        rows.append(tuple(canonicalize(v) for v in parts))
+    return rows
 
 
 def discover_scalar_paths(
     extractions: List[Dict[str, Any]], list_key: Optional[str] = None
 ) -> List[JSONPath]:
     """Dot paths resolving to scalars anywhere in any record (lists excluded)."""
-    candidates: Set[str] = set()
-    for extraction in extractions:
-        for record in iter_records(extraction, list_key=list_key):
-            if not isinstance(record, dict):
-                continue
-            stack: List[Tuple[str, Any]] = [("", record)]
-            while stack:
-                base, node = stack.pop()
-                if not isinstance(node, dict):
-                    continue
-                for key, value in node.items():
-                    path = f"{base}.{key}" if base else key
-                    if isinstance(value, dict):
-                        stack.append((path, value))
-                    elif isinstance(value, list):
-                        continue
-                    else:
-                        candidates.add(path)
-    return sorted(candidates)
+
+    def scalar_paths(node: Dict[str, Any], base: str) -> Iterator[str]:
+        for k, v in node.items():
+            dotted = f"{base}.{k}" if base else k
+            if isinstance(v, dict):
+                yield from scalar_paths(v, dotted)
+            elif not isinstance(v, list):
+                yield dotted
+
+    found = {
+        p
+        for e in extractions
+        for rec in iter_records(e, list_key=list_key)
+        for p in scalar_paths(rec, "")
+    }
+    return sorted(found)
 
 
-def jaccard(a: Set[Any], b: Set[Any]) -> float:
-    if not a and not b:
+def jaccard(a: set, b: set) -> float:
+    if not (a or b):
         return 1.0
-    if not a or not b:
-        return 0.0
-    uni = len(a | b)
-    return len(a & b) / uni if uni else 1.0
+    union = a | b
+    return len(a & b) / len(union) if union else 1.0
 
 
-class KeyMetrics(BaseModel):
-    model_config = ConfigDict(frozen=True)
+@dataclass(frozen=True)
+class KeyMetrics:
+    """Quality profile of one candidate key across the extraction family.
 
-    path: Tuple[str, ...]  # 1 path for single keys, >1 for composite
-    coverage_min: float
-    coverage_mean: float
-    uniqueness_min: float
-    uniqueness_mean: float
-    jaccard_min: float
-    jaccard_mean: float
-    I_E: int  # values present in all extractions
-    I_E_minus_1: int  # present in E-1 extractions
-    I_ge_2: int  # present in at least 2 extractions
-    union_size: int
-    score_tuple: Tuple  # lexicographic ranking score
+    ``overlap_*`` = pairwise Jaccard of value sets; ``n_all`` / ``n_all_but_1``
+    / ``n_shared`` = support histogram (values seen in every / all-but-one /
+    >=2 extractions); ``cover_*`` / ``unique_*`` = per-extraction record
+    coverage and value uniqueness, min/mean-aggregated."""
+
+    path: Tuple[str, ...]
+    cover_lo: float
+    cover_avg: float
+    unique_lo: float
+    unique_avg: float
+    overlap_lo: float
+    overlap_avg: float
+    n_all: int
+    n_all_but_1: int
+    n_shared: int
+    union_n: int
+
+    @property
+    def depth(self) -> int:
+        return sum(p.count(".") for p in self.path)
+
+    @property
+    def score_tuple(self) -> Tuple:
+        """9-component lexicographic rank: worst-pair overlap, full/near-full
+        support, mean overlap, uniqueness, coverage, small unions, deep paths,
+        few components."""
+        return (
+            round(self.overlap_lo, 6),
+            self.n_all,
+            self.n_all_but_1,
+            round(self.overlap_avg, 6),
+            round(self.unique_lo, 6),
+            round(self.cover_lo, 6),
+            -self.union_n,
+            self.depth,
+            -len(self.path),
+        )
+
+    @property
+    def stability(self) -> Tuple:
+        return (round(self.overlap_lo, 6), self.n_all, self.n_all_but_1, round(self.overlap_avg, 6))
 
 
-def _evaluate(
+def measure_key(
     extractions: List[Dict[str, Any]],
-    per_vals: List[List[Any]],
-    path: Tuple[str, ...],
-    depth_hint: int,
-    n_paths: int,
-    list_key: Optional[str],
+    key: Tuple[JSONPath, ...],
+    list_key: Optional[str] = None,
+    canonicalize: Callable[[Any], Any] = normalize_scalar,
 ) -> KeyMetrics:
-    E = len(extractions)
-    per_sets = [set(vs) for vs in per_vals]
-
-    coverage: List[float] = []
-    uniqueness: List[float] = []
-    for vs, e in zip(per_vals, extractions):
-        total = len(iter_records(e, list_key=list_key))
-        non_null = len(vs)
-        coverage.append(non_null / max(1, total))
-        cnt = Counter(vs)
-        uniq = sum(1 for _v, c in cnt.items() if c == 1)
-        uniqueness.append(uniq / max(1, non_null) if non_null else 0.0)
-
-    j_scores = [
-        jaccard(per_sets[i], per_sets[j]) for i in range(E) for j in range(i + 1, E)
+    """Profile one candidate key (any arity) across the extraction family."""
+    columns = [
+        project_key(e, key, list_key=list_key, canonicalize=canonicalize) for e in extractions
     ]
-    j_mean = sum(j_scores) / len(j_scores) if j_scores else 1.0
-    j_min = min(j_scores) if j_scores else 1.0
+    value_sets = [set(c) for c in columns]
+    n_files = len(extractions)
 
-    support: Counter = Counter()
-    for s in per_sets:
-        for v in s:
-            support[v] += 1
-    counts_by_sup = Counter(support.values())
-    I_E = counts_by_sup.get(E, 0)
-    I_Em1 = counts_by_sup.get(E - 1, 0) if E >= 2 else 0
-    I_2p = sum(c for sup, c in counts_by_sup.items() if sup >= 2)
-    U = len(set().union(*per_sets)) if per_sets else 0
+    cover: List[float] = []
+    unique: List[float] = []
+    for rows, e in zip(columns, extractions):
+        n_records = len(iter_records(e, list_key=list_key))
+        cover.append(len(rows) / max(1, n_records))
+        if rows:
+            tally = Counter(rows)
+            unique.append(sum(1 for n in tally.values() if n == 1) / max(1, len(rows)))
+        else:
+            unique.append(0.0)
 
-    score_tuple = (
-        round(j_min, 6),  # 1) worst-pair Jaccard
-        I_E,  # 2) values present in all files
-        I_Em1,  # 3) then E-1 files
-        round(j_mean, 6),  # 4) mean Jaccard
-        round(min(uniqueness), 6),  # 5) intra-JSON uniqueness (min)
-        round(min(coverage), 6),  # 6) intra-JSON coverage (min)
-        -U,  # 7) discourage large unions
-        depth_hint,  # 8) prefer deeper paths
-        -n_paths,  # 9) prefer fewer key components
-    )
+    overlaps = [jaccard(a, b) for a, b in combinations(value_sets, 2)]
+    seen_in = Counter(v for s in value_sets for v in s)
+    histogram = Counter(seen_in.values())
 
     return KeyMetrics(
-        path=path,
-        coverage_min=min(coverage) if coverage else 0.0,
-        coverage_mean=sum(coverage) / len(coverage) if coverage else 0.0,
-        uniqueness_min=min(uniqueness) if uniqueness else 0.0,
-        uniqueness_mean=sum(uniqueness) / len(uniqueness) if uniqueness else 0.0,
-        jaccard_min=j_min,
-        jaccard_mean=j_mean,
-        I_E=I_E,
-        I_E_minus_1=I_Em1,
-        I_ge_2=I_2p,
-        union_size=U,
-        score_tuple=score_tuple,
+        path=key,
+        cover_lo=min(cover, default=0.0),
+        cover_avg=sum(cover) / len(cover) if cover else 0.0,
+        unique_lo=min(unique, default=0.0),
+        unique_avg=sum(unique) / len(unique) if unique else 0.0,
+        overlap_lo=min(overlaps, default=1.0),
+        overlap_avg=sum(overlaps) / len(overlaps) if overlaps else 1.0,
+        n_all=histogram.get(n_files, 0),
+        n_all_but_1=histogram.get(n_files - 1, 0) if n_files >= 2 else 0,
+        n_shared=sum(n for support, n in histogram.items() if support >= 2),
+        union_n=len(seen_in),
     )
 
 
-def evaluate_single_key(
-    extractions: List[Dict[str, Any]],
-    path: JSONPath,
-    list_key: Optional[str] = None,
-    canonicalize: Callable[[Any], Any] = normalize_scalar,
-) -> KeyMetrics:
-    per_vals = [
-        values_for_path(e, path, list_key=list_key, canonicalize=canonicalize)
-        for e in extractions
-    ]
-    return _evaluate(
-        extractions, per_vals, (path,), depth_hint=path.count("."), n_paths=1, list_key=list_key
-    )
-
-
-def evaluate_composite_key(
-    extractions: List[Dict[str, Any]],
-    paths: List[JSONPath],
-    list_key: Optional[str] = None,
-    canonicalize: Callable[[Any], Any] = normalize_scalar,
-) -> KeyMetrics:
-    per_vals = [
-        tuple_values_for_paths(e, paths, list_key=list_key, canonicalize=canonicalize)
-        for e in extractions
-    ]
-    return _evaluate(
-        extractions,
-        per_vals,
-        tuple(paths),
-        depth_hint=sum(p.count(".") for p in paths),
-        n_paths=len(paths),
-        list_key=list_key,
-    )
-
-
-class CascadeConfig(BaseModel):
-    model_config = ConfigDict(frozen=True)
-
+@dataclass(frozen=True)
+class CascadeConfig:
     min_coverage: float = 0.0
     min_uniqueness: float = 0.0
-    topk_stage1: int = 30  # after stability sort
-    topk_stage2: int = 12  # after intra-JSON sort
-    topk_stage3: int = 6  # after union filter
+    topk_stage1: int = 30  # survivors of the stability sort
+    topk_stage2: int = 12  # survivors of the intra-JSON sort
+    topk_stage3: int = 6  # survivors of the union-parsimony sort
 
 
-class CascadeReport(BaseModel):
-    model_config = ConfigDict(frozen=True)
-
+@dataclass(frozen=True)
+class CascadeReport:
     stage0_kept: List[KeyMetrics]
     stage1_kept: List[KeyMetrics]
     stage2_kept: List[KeyMetrics]
@@ -289,60 +229,44 @@ def cascade_select_keys(
     list_key: Optional[str] = None,
     canonicalize: Callable[[Any], Any] = normalize_scalar,
 ) -> CascadeReport:
-    """4-stage funnel: gate -> stability -> intra-JSON quality -> parsimony,
-    with depth/fewer-components tie-breakers."""
-    singles = [
-        evaluate_single_key(extractions, p, list_key=list_key, canonicalize=canonicalize)
-        for p in candidates
-    ]
-
-    pool0 = [
+    """4-stage funnel: admission gate -> stability -> intra-JSON quality ->
+    union parsimony, finished by a depth / fewer-components tie-break."""
+    admitted = [
         m
-        for m in singles
-        if (
-            m.I_ge_2 > 0
-            and m.jaccard_min > 0.0
-            and m.coverage_min >= config.min_coverage
-            and m.uniqueness_min >= config.min_uniqueness
+        for m in (
+            measure_key(extractions, (p,), list_key=list_key, canonicalize=canonicalize)
+            for p in candidates
         )
+        if m.n_shared > 0
+        and m.overlap_lo > 0.0
+        and m.cover_lo >= config.min_coverage
+        and m.unique_lo >= config.min_uniqueness
     ]
-    if not pool0:
+    if not admitted:
         raise ValueError(
-            "No keys pass Stage 0 (require I_ge_2>0, jaccard_min>0, and coverage)."
+            "No keys pass Stage 0 (require shared values, nonzero worst-pair "
+            "overlap, and the coverage/uniqueness gates)."
         )
 
-    pool1 = sorted(
-        pool0,
-        key=lambda m: (m.I_E, m.I_E_minus_1, round(m.jaccard_min, 6), round(m.jaccard_mean, 6)),
-        reverse=True,
-    )[: config.topk_stage1]
-
-    pool2 = sorted(
-        pool1,
-        key=lambda m: (round(m.uniqueness_min, 6), round(m.coverage_min, 6)),
-        reverse=True,
-    )[: config.topk_stage2]
-
-    pool3 = sorted(pool2, key=lambda m: (m.union_size,))[: config.topk_stage3]
-
-    final_sorted = sorted(
-        pool3,
-        key=lambda m: (sum(p.count(".") for p in m.path), -len(m.path)),
-        reverse=True,
+    funnel = (
+        (
+            lambda m: (m.n_all, m.n_all_but_1, round(m.overlap_lo, 6), round(m.overlap_avg, 6)),
+            True,
+            config.topk_stage1,
+        ),
+        (lambda m: (round(m.unique_lo, 6), round(m.cover_lo, 6)), True, config.topk_stage2),
+        (lambda m: m.union_n, False, config.topk_stage3),
     )
+    pools = [admitted]
+    for rank, descending, cap in funnel:
+        pools.append(sorted(pools[-1], key=rank, reverse=descending)[:cap])
 
-    return CascadeReport(
-        stage0_kept=pool0,
-        stage1_kept=pool1,
-        stage2_kept=pool2,
-        stage3_kept=pool3,
-        final_best=final_sorted[0],
-    )
+    winner = max(pools[-1], key=lambda m: (m.depth, -len(m.path)))
+    return CascadeReport(*pools, final_best=winner)
 
 
-class KeySelectionResult(BaseModel):
-    model_config = ConfigDict(frozen=True)
-
+@dataclass(frozen=True)
+class KeySelectionResult:
     best_single: KeyMetrics
     best_composite: Optional[KeyMetrics]
     candidate_table: List[KeyMetrics]
@@ -351,7 +275,7 @@ class KeySelectionResult(BaseModel):
 
 
 def stability_tuple(m: KeyMetrics) -> Tuple:
-    return (round(m.jaccard_min, 6), m.I_E, m.I_E_minus_1, round(m.jaccard_mean, 6))
+    return m.stability
 
 
 def select_best_keys(
@@ -365,66 +289,51 @@ def select_best_keys(
     """Cascade over singles, then greedy + brute-force composite improvement."""
     if not extractions:
         raise ValueError("No extractions provided.")
-
-    E = len(extractions)
-    t = max(2, math.ceil(min_support_ratio_for_autolock * E))
-
     candidates = discover_scalar_paths(extractions, list_key=list_key)
     if not candidates:
         raise ValueError("No scalar candidate paths discovered.")
 
     report = cascade_select_keys(extractions, candidates, cascade_cfg, list_key=list_key)
-    best_single = report.final_best
 
-    singles_all = [
-        evaluate_single_key(extractions, p, list_key=list_key) for p in candidates
-    ]
-    singles_all = [m for m in singles_all if (m.I_ge_2 > 0 and m.jaccard_min > 0.0)]
-    singles_all.sort(
-        key=lambda m: (
-            round(m.jaccard_min, 6),
-            m.I_E,
-            m.I_E_minus_1,
-            round(m.jaccard_mean, 6),
-            round(m.uniqueness_min, 6),
-            round(m.coverage_min, 6),
-            -m.union_size,
+    # Ranked table of every admissible single key (diagnostic output).
+    table = sorted(
+        (
+            m
+            for m in (measure_key(extractions, (p,), list_key=list_key) for p in candidates)
+            if m.n_shared > 0 and m.overlap_lo > 0.0
         ),
+        key=lambda m: m.score_tuple[:7],
         reverse=True,
     )
 
-    # Greedy growth from the stage-3 pool (strict improvement on BOTH score and
-    # stability), then a brute-force sweep over 2..max_k combinations accepting
-    # either-improves — matching the reference's accept conditions (:426, :436).
-    topN_paths = [m.path[0] for m in report.stage3_kept][:max_candidates_for_composite]
-    best_combo: Optional[KeyMetrics] = None
-    if topN_paths:
-        current = [topN_paths[0]]
-        best_combo = evaluate_composite_key(extractions, current, list_key=list_key)
-        improved = True
-        while improved and len(current) < max_k:
-            improved = False
-            for cand in (p for p in topN_paths if p not in current):
-                trial = evaluate_composite_key(extractions, current + [cand], list_key=list_key)
-                if trial.score_tuple > best_combo.score_tuple and stability_tuple(
-                    trial
-                ) > stability_tuple(best_combo):
-                    best_combo = trial
-                    current.append(cand)
-                    improved = True
+    # Composite search seeded from the stage-3 pool: greedy growth requires a
+    # strict improvement on BOTH score and stability; the brute-force sweep over
+    # 2..max_k combinations accepts either-improves (reference :426, :436).
+    seeds = [m.path[0] for m in report.stage3_kept][:max_candidates_for_composite]
+    champion: Optional[KeyMetrics] = None
+    if seeds:
+        chosen = [seeds[0]]
+        champion = measure_key(extractions, tuple(chosen), list_key=list_key)
+        growing = True
+        while growing and len(chosen) < max_k:
+            growing = False
+            for extra in seeds:
+                if extra in chosen:
+                    continue
+                trial = measure_key(extractions, tuple(chosen + [extra]), list_key=list_key)
+                if trial.score_tuple > champion.score_tuple and trial.stability > champion.stability:
+                    champion, chosen, growing = trial, chosen + [extra], True
 
-        for r in range(2, min(max_k, len(topN_paths)) + 1):
-            for combo in combinations(topN_paths, r):
-                trial = evaluate_composite_key(extractions, list(combo), list_key=list_key)
-                if stability_tuple(trial) > stability_tuple(best_combo) or (
-                    trial.score_tuple > best_combo.score_tuple
-                ):
-                    best_combo = trial
+        for arity in range(2, min(max_k, len(seeds)) + 1):
+            for combo in combinations(seeds, arity):
+                trial = measure_key(extractions, combo, list_key=list_key)
+                if trial.stability > champion.stability or trial.score_tuple > champion.score_tuple:
+                    champion = trial
 
     return KeySelectionResult(
-        best_single=best_single,
-        best_composite=best_combo,
-        candidate_table=singles_all,
-        min_support_for_autolock=t,
+        best_single=report.final_best,
+        best_composite=champion,
+        candidate_table=table,
+        min_support_for_autolock=max(2, math.ceil(min_support_ratio_for_autolock * len(extractions))),
         cascade_report=report,
     )
