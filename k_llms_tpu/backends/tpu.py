@@ -111,7 +111,8 @@ class TpuBackend(Backend):
         # response_format compiles to a schema DFA — keys, types, and enums
         # enforced, so every sample validates into the user's model; anything
         # the compiler can't express falls back to the valid-JSON automaton.
-        # Byte-level tokenizers only; BPE vocabs free-generate.
+        # Byte tokenizers run the automata directly; BPE vocabularies get
+        # token-level masks compiled over the vocabulary (token_constraint.py).
         constraint = self._constraint_for(request.response_format)
         result = self.scheduler.call(
             lambda: self.engine.generate(
@@ -190,7 +191,7 @@ class TpuBackend(Backend):
         )
 
     def _constraint_for(self, response_format: Any):
-        if response_format is None or not getattr(self.tokenizer, "is_byte_level", False):
+        if response_format is None:
             return None
         schema = None
         wants_json = False
@@ -204,6 +205,12 @@ class TpuBackend(Backend):
                 # OpenAI wire form: {"type": "json_schema", "json_schema": {"schema": ...}}
                 schema = (response_format.get("json_schema") or {}).get("schema")
                 wants_json = True  # schema-less json_schema payload degrades to JSON mask
+        if schema is None and not wants_json:
+            # {"type": "text"} and unrecognized forms are unconstrained — only
+            # an explicit JSON request earns the grammar mask.
+            return None
+
+        byte_level = getattr(self.tokenizer, "is_byte_level", False)
         if schema is not None:
             import json
 
@@ -217,15 +224,44 @@ class TpuBackend(Backend):
 
             try:
                 dfa = compile_schema(schema)
-                self._dfa_cache[digest] = dfa
-                return dfa
             except SchemaUnsupported as e:
                 logger.info("schema DFA unsupported (%s); using generic JSON mask", e)
-                self._dfa_cache[digest] = "json"
-                return "json"
-        # {"type": "text"} and unrecognized forms are unconstrained — only an
-        # explicit JSON request earns the grammar mask.
-        return "json" if wants_json else None
+                dfa = None
+            if byte_level:
+                constraint = dfa if dfa is not None else "json"
+            else:
+                # BPE vocabularies: lift the byte automaton to token level
+                # (per-state vocab bitmasks, Outlines-style) so the grammar
+                # guarantee holds on real checkpoints too.
+                from ..engine.token_constraint import schema_token_constraint
+
+                vocab = self._vocab_bytes()
+                constraint = (
+                    schema_token_constraint(dfa, vocab)
+                    if dfa is not None
+                    else self._json_token_constraint()
+                )
+            self._dfa_cache[digest] = constraint
+            return constraint
+        if byte_level:
+            return "json"
+        return self._json_token_constraint()
+
+    def _vocab_bytes(self):
+        if getattr(self, "_vocab_bytes_cache", None) is None:
+            from ..engine.token_constraint import vocab_byte_strings
+
+            self._vocab_bytes_cache = vocab_byte_strings(self.tokenizer)
+        return self._vocab_bytes_cache
+
+    def _json_token_constraint(self):
+        cached = self._dfa_cache.get("json-token")
+        if cached is None:
+            from ..engine.token_constraint import json_token_constraint
+
+            cached = json_token_constraint(self._vocab_bytes())
+            self._dfa_cache["json-token"] = cached
+        return cached
 
     # -- embeddings -------------------------------------------------------
     def embeddings(self, texts: List[str]) -> List[List[float]]:

@@ -158,8 +158,12 @@ class LocalEngine:
         top_k: Optional[int],
         constraint: Optional[str] = None,
     ):
+        from .token_constraint import TokenConstraint
+
         constraint_key = constraint
-        if constraint is not None and constraint != "json":
+        if isinstance(constraint, TokenConstraint):
+            constraint_key = ("token", constraint.digest)
+        elif constraint is not None and constraint != "json":
             constraint_key = ("schema", constraint.digest)
         cache_key = (n, max_new, temperature, top_p, top_k, constraint_key)
         fn = self._decode_cache.get(cache_key)
@@ -173,6 +177,18 @@ class LocalEngine:
             from .json_constraint import advance, device_tables, initial_state, mask_logits
 
             jt = device_tables()
+        elif isinstance(constraint, TokenConstraint):  # BPE vocabularies
+            from .token_constraint import (
+                device_token_table,
+                token_advance,
+                token_initial_state,
+                token_mask_logits,
+            )
+
+            jt = device_token_table(constraint)
+            initial_state = lambda n: (token_initial_state(jt, n),)  # noqa: E731
+            mask_logits = token_mask_logits
+            advance = lambda t, tok, state: (token_advance(t, tok, state),)  # noqa: E731
         elif constraint is not None:  # a compiled SchemaDFA
             from .schema_constraint import (
                 device_dfa,
@@ -280,14 +296,29 @@ class LocalEngine:
 
         # Validate before any device work (prefill compiles take seconds).
         from .schema_constraint import SchemaDFA
+        from .token_constraint import TokenConstraint
 
-        if constraint is not None and constraint != "json" and not isinstance(constraint, SchemaDFA):
+        if constraint is not None and constraint != "json" and not isinstance(
+            constraint, (SchemaDFA, TokenConstraint)
+        ):
             raise ValueError(
-                f"Unknown constraint {constraint!r}; supported: 'json' or a compiled SchemaDFA"
+                f"Unknown constraint {constraint!r}; supported: 'json', a compiled "
+                "SchemaDFA, or a compiled TokenConstraint"
             )
-        if constraint is not None:
-            # The masks treat token ids 0..255 AS bytes — the caller must use a
-            # byte-level tokenizer (TpuBackend gates on tokenizer.is_byte_level).
+        if isinstance(constraint, TokenConstraint):
+            # Token-level masks carry their own vocabulary; the model head must
+            # cover it, and eos must be a special (len-0) or out-of-vocab id so
+            # opening its column cannot alias a grammar token.
+            if config.vocab_size < constraint.vocab_size:
+                raise ValueError(
+                    f"model vocab {config.vocab_size} < constraint vocab "
+                    f"{constraint.vocab_size}"
+                )
+            if any(0 <= e < constraint.vocab_size and constraint.token_len[e] > 0 for e in eos):
+                raise ValueError("eos ids must be special tokens under a TokenConstraint")
+        elif constraint is not None:
+            # The byte masks treat token ids 0..255 AS bytes — the caller must
+            # use a byte-level tokenizer (TpuBackend gates on is_byte_level).
             # Specials (eos/pad) must live above the byte range, or the eos
             # column would alias onto a byte and corrupt the automaton.
             if config.vocab_size <= 256 or any(e < 256 for e in eos):
