@@ -13,6 +13,7 @@ time, and tests skip cleanly when /root/reference is absent.
 
 from __future__ import annotations
 
+import importlib.machinery
 import importlib.util
 import os
 import sys
@@ -28,10 +29,20 @@ def reference_available() -> bool:
     return os.path.isdir(os.path.join(REFERENCE_ROOT, "k_llms", "utils"))
 
 
+def _stub_module(name: str) -> types.ModuleType:
+    """A ModuleType with a real ModuleSpec, so later
+    ``importlib.util.find_spec(name)`` (e.g. transformers' optional-dependency
+    probe) sees a well-formed module instead of raising on ``__spec__ is None``.
+    """
+    mod = types.ModuleType(name)
+    mod.__spec__ = importlib.machinery.ModuleSpec(name, loader=None)
+    return mod
+
+
 def _install_stub_modules() -> None:
     # --- cachetools: only TTLCache is used ---
     if "cachetools" not in sys.modules:
-        cachetools = types.ModuleType("cachetools")
+        cachetools = _stub_module("cachetools")
 
         class TTLCache(dict):
             def __init__(self, maxsize=1024, ttl=300):
@@ -49,7 +60,7 @@ def _install_stub_modules() -> None:
 
     # --- unidecode: mirror our ascii_fold so both engines sanitize identically ---
     if "unidecode" not in sys.modules:
-        unidecode_mod = types.ModuleType("unidecode")
+        unidecode_mod = _stub_module("unidecode")
 
         from k_llms_tpu.consensus.text import ascii_fold
 
@@ -60,7 +71,7 @@ def _install_stub_modules() -> None:
     if "openai" not in sys.modules:
         from k_llms_tpu.types import wire
 
-        openai_mod = types.ModuleType("openai")
+        openai_mod = _stub_module("openai")
 
         class OpenAI:  # pragma: no cover - never actually called by the oracle
             def __init__(self, *a, **kw):
@@ -73,8 +84,8 @@ def _install_stub_modules() -> None:
         openai_mod.OpenAI = OpenAI
         openai_mod.AsyncOpenAI = AsyncOpenAI
 
-        openai_types = types.ModuleType("openai.types")
-        completion_usage = types.ModuleType("openai.types.completion_usage")
+        openai_types = _stub_module("openai.types")
+        completion_usage = _stub_module("openai.types.completion_usage")
         completion_usage.CompletionUsage = wire.CompletionUsage
         completion_usage.CompletionTokensDetails = wire.CompletionTokensDetails
         completion_usage.PromptTokensDetails = wire.PromptTokensDetails
@@ -87,10 +98,10 @@ def _install_stub_modules() -> None:
 
     # --- retab: one type import, never instantiated in the paths we exercise ---
     if "retab" not in sys.modules:
-        retab = types.ModuleType("retab")
-        retab_types = types.ModuleType("retab.types")
-        retab_docs = types.ModuleType("retab.types.documents")
-        retab_extract = types.ModuleType("retab.types.documents.extract")
+        retab = _stub_module("retab")
+        retab_types = _stub_module("retab.types")
+        retab_docs = _stub_module("retab.types.documents")
+        retab_extract = _stub_module("retab.types.documents.extract")
 
         class RetabParsedChatCompletion:  # minimal placeholder
             pass
