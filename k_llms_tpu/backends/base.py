@@ -3,10 +3,14 @@
 from __future__ import annotations
 
 import abc
+import threading
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Union
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Union
 
 from ..types import ChatCompletion
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..consensus.similarity import SimilarityScorer
 
 
 @dataclass
@@ -64,6 +68,27 @@ class Backend(abc.ABC):
         reference crops via tiktoken before embedding, `client.py:98-102`).
         Backends without a tokenizer pass texts through unchanged."""
         return list(texts)
+
+    # One lock guards lazy scorer-registry creation across all backends; the
+    # registry itself lives per-instance so caches follow the engine (and die
+    # with it), like the reference's module-global TTL caches follow the process
+    # (`consensus_utils.py:620-623`).
+    _scorer_registry_lock = threading.Lock()
+
+    def similarity_scorer(self, method: str) -> "SimilarityScorer":
+        """The shared per-method similarity scorer for this backend. Every
+        request through the same backend reuses one scorer per similarity
+        method, so embedding/similarity TTL caches (1024 entries / 300 s)
+        amortize across requests instead of being rebuilt per call."""
+        from ..consensus.similarity import SimilarityScorer
+
+        with Backend._scorer_registry_lock:
+            registry = self.__dict__.setdefault("_similarity_scorers", {})
+            scorer = registry.get(method)
+            if scorer is None:
+                scorer = SimilarityScorer(method=method, embed_fn=self.embeddings)
+                registry[method] = scorer
+            return scorer
 
     def llm_consensus(self, values: List[str]) -> str:
         """Build a consensus string from candidates (reference

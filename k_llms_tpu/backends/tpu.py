@@ -114,17 +114,14 @@ class TpuBackend(Backend):
         # Byte tokenizers run the automata directly; BPE vocabularies get
         # token-level masks compiled over the vocabulary (token_constraint.py).
         constraint = self._constraint_for(request.response_format)
-        result = self.scheduler.call(
-            lambda: self.engine.generate(
-                prompt_ids,
-                n=n,
-                max_new_tokens=max_new,
-                temperature=temperature,
-                top_p=request.top_p,
-                seed=request.seed,
-                eos_ids=tok.stop_ids,
-                constraint=constraint,
-            )
+        result = self._generate_batched(
+            prompt_ids,
+            n=n,
+            max_new=max_new,
+            temperature=temperature,
+            top_p=request.top_p,
+            seed=request.seed,
+            constraint=constraint,
         )
 
         stop_strings: List[str] = []
@@ -188,6 +185,51 @@ class TpuBackend(Backend):
                     "total_tokens": result.prompt_len + completion_tokens,
                 },
             }
+        )
+
+    def _generate_batched(
+        self,
+        prompt_ids: List[int],
+        *,
+        n: int,
+        max_new: int,
+        temperature: float,
+        top_p: Optional[float],
+        seed: Optional[int],
+        constraint: Any,
+    ):
+        """Submit one generation through the coalescing scheduler: concurrent
+        requests with the same sampling config decode as ONE batched XLA
+        program (`LocalEngine.generate_many`); a lone request runs solo."""
+        from ..engine.engine import GenRequestSpec
+
+        ckey = None
+        if constraint is not None:
+            ckey = (
+                "json"
+                if constraint == "json"
+                else (type(constraint).__name__, constraint.digest)
+            )
+        eos_ids = self.tokenizer.stop_ids
+        batch_key = (max_new, temperature, top_p, ckey, tuple(eos_ids))
+
+        def run(specs):
+            return self.engine.generate_many(
+                specs,
+                max_new_tokens=max_new,
+                temperature=temperature,
+                top_p=top_p,
+                eos_ids=eos_ids,
+                constraint=constraint,
+            )
+
+        # Weight = this request's padded row count (the engine rounds n up to a
+        # data-parallel multiple), so the scheduler's max_rows bound tracks the
+        # batch the device will actually see.
+        dp = self.engine.data_parallel_size
+        rows = ((max(1, n) + dp - 1) // dp) * dp
+        return self.scheduler.call_batched(
+            batch_key, GenRequestSpec(list(prompt_ids), n, seed), run, weight=rows
         )
 
     def _constraint_for(self, response_format: Any):
@@ -268,7 +310,20 @@ class TpuBackend(Backend):
         token_lists = [
             self.tokenizer.encode(t)[:MAX_EMBEDDING_TOKENS] for t in texts
         ]
-        pooled = self.scheduler.call(lambda: self.engine.embed_tokens(token_lists))
+
+        def run(payloads):
+            # Concurrent requests' embedding batches coalesce into one forward.
+            flat = [tl for p in payloads for tl in p]
+            pooled = self.engine.embed_tokens(flat)
+            out, i = [], 0
+            for p in payloads:
+                out.append(pooled[i : i + len(p)])
+                i += len(p)
+            return out
+
+        pooled = self.scheduler.call_batched(
+            ("embed",), token_lists, run, weight=max(1, len(token_lists))
+        )
         return [[float(x) for x in row] for row in pooled]
 
     def crop_texts(
@@ -300,14 +355,10 @@ class TpuBackend(Backend):
             {"role": "user", "content": f"Input: {[json.dumps(v) for v in values]}\nOutput:"},
         ]
         ids = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
-        result = self.scheduler.call(
-            lambda: self.engine.generate(
-                ids,
-                n=1,
-                max_new_tokens=128,
-                temperature=0.0,
-                eos_ids=self.tokenizer.stop_ids,
-            )
+        # Batched like user requests: llm-consensus calls issued by concurrent
+        # consolidations coalesce into one greedy decode.
+        result = self._generate_batched(
+            ids, n=1, max_new=128, temperature=0.0, top_p=None, seed=None, constraint=None
         )
         text = self.tokenizer.decode(
             [int(t) for t in result.tokens[0][: int(result.lengths[0])]]

@@ -49,6 +49,14 @@ class GenerationResult(NamedTuple):
     prompt_len: int
 
 
+class GenRequestSpec(NamedTuple):
+    """One request's slice of a coalesced decode batch (see generate_many)."""
+
+    prompt_ids: List[int]
+    n: int = 1
+    seed: Optional[int] = None
+
+
 def _bucket(n: int, minimum: int = 32) -> int:
     b = minimum
     while b < n:
@@ -151,13 +159,24 @@ class LocalEngine:
     # -- decode loop ------------------------------------------------------
     def _get_decode_loop(
         self,
-        n: int,
+        num_requests: int,
+        n_per: int,
         max_new: int,
         temperature: float,
         top_p: Optional[float],
         top_k: Optional[int],
         constraint: Optional[str] = None,
     ):
+        """Jitted decode loop for R requests × n_per samples each (R=1 is the
+        single-request case; R>1 is the cross-request coalesced batch).
+
+        Rows are grouped request-major, so each request's shared-prefix KV is
+        consumed by its own row group through the reshaped einsum in
+        ``_gqa_scores_shared`` — no per-row gather, no prefix duplication.
+        Per-row PRNG keys derive from (request key, step, row-within-request),
+        so a request's samples are reproducible regardless of what it was
+        batched with.
+        """
         from .token_constraint import TokenConstraint
 
         constraint_key = constraint
@@ -165,13 +184,16 @@ class LocalEngine:
             constraint_key = ("token", constraint.digest)
         elif constraint is not None and constraint != "json":
             constraint_key = ("schema", constraint.digest)
-        cache_key = (n, max_new, temperature, top_p, top_k, constraint_key)
+        cache_key = (
+            num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
+        )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
             return fn
 
         config = self.config
         pad_id = config.pad_token_id
+        R, B = num_requests, num_requests * n_per
 
         if constraint == "json":
             from .json_constraint import advance, device_tables, initial_state, mask_logits
@@ -203,8 +225,18 @@ class LocalEngine:
             mask_logits = dfa_mask_logits
             advance = lambda t, tok, state: (dfa_advance(t, tok, state),)  # noqa: E731
 
-        def _loop(params, prefix: KVCache, prompt_len, first_logits, key, eos_ids):
-            gen_cache = init_cache(config, n, max_new)
+        def _row_keys(req_keys, step):
+            # fold_in(fold_in(req_key, step), row_within_request): with R=1
+            # this is exactly sample_logits' internal per-row fold of a
+            # step-folded key, so solo results are R-independent.
+            step_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(req_keys, step)
+            rk = jax.vmap(
+                lambda k: jax.vmap(lambda i: jax.random.fold_in(k, i))(jnp.arange(n_per))
+            )(step_keys)
+            return rk.reshape(B)
+
+        def _loop(params, prefix: KVCache, prompt_lens, first_logits, req_keys, eos_ids):
+            gen_cache = init_cache(config, B, max_new)
             gen_cache = KVCache(
                 k=self._constraint(gen_cache.k, cache_specs()),
                 v=self._constraint(gen_cache.v, cache_specs()),
@@ -214,20 +246,21 @@ class LocalEngine:
                 sample_logits, temperature=temperature, top_p=top_p, top_k=top_k
             )
 
-            jstate = initial_state(n) if constraint is not None else None
+            jstate = initial_state(B) if constraint is not None else None
 
-            # First token: the shared prefill logits, n independent draws.
-            logits0 = jnp.broadcast_to(first_logits[0], (n, first_logits.shape[-1]))
+            # First token: each request's prefill logits, n_per draws apiece.
+            V = first_logits.shape[-1]
+            logits0 = jnp.broadcast_to(first_logits[:, None, :], (R, n_per, V)).reshape(B, V)
             if jstate is not None:
                 logits0 = mask_logits(jt, logits0, *jstate, eos_ids)
-            tok0, lp0 = sample(logits0, jax.random.fold_in(key, 0))
+            tok0, lp0 = sample(logits0, None, row_keys=_row_keys(req_keys, jnp.int32(0)))
             tok0 = self._constraint(tok0, batch_spec())
             if jstate is not None:
                 jstate = advance(jt, tok0, *jstate)
             done0 = jnp.isin(tok0, eos_ids)
 
-            tokens_buf = jnp.full((n, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
-            logprob_buf = jnp.zeros((n, max_new), jnp.float32).at[:, 0].set(lp0)
+            tokens_buf = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
+            logprob_buf = jnp.zeros((B, max_new), jnp.float32).at[:, 0].set(lp0)
 
             def cond(state):
                 step, cur, done, *_ = state
@@ -236,11 +269,11 @@ class LocalEngine:
             def body(state):
                 step, cur, done, cache, toks, lps, jst = state
                 logits, cache = decode_step(
-                    config, params, cur, step, prompt_len, cache, prefix
+                    config, params, cur, step, prompt_lens, cache, prefix
                 )
                 if jst is not None:
                     logits = mask_logits(jt, logits, *jst, eos_ids)
-                nxt, lp = sample(logits, jax.random.fold_in(key, step + 1))
+                nxt, lp = sample(logits, None, row_keys=_row_keys(req_keys, step + 1))
                 nxt = jnp.where(done, pad_id, nxt).astype(jnp.int32)
                 nxt = self._constraint(nxt, batch_spec())
                 if jst is not None:
@@ -259,46 +292,36 @@ class LocalEngine:
         self._decode_cache[cache_key] = fn
         return fn
 
-    # -- public API -------------------------------------------------------
-    def generate(
-        self,
-        prompt_ids: Sequence[int],
-        n: int = 1,
-        max_new_tokens: int = 128,
-        temperature: float = 1.0,
-        top_p: Optional[float] = None,
-        top_k: Optional[int] = None,
-        seed: Optional[int] = None,
-        eos_ids: Optional[Sequence[int]] = None,
-        constraint: Optional[str] = None,
-    ) -> GenerationResult:
+    # -- request prep -----------------------------------------------------
+    def _prep_prompt(self, prompt_ids: Sequence[int]) -> Tuple[List[int], int, int]:
+        """Normalize a prompt: BOS fallback, left-truncate to max_seq_len, and
+        pick the power-of-two compile bucket. Returns (ids, prompt_len, bucket)."""
         config = self.config
-        prompt_ids = list(prompt_ids)
-        if not prompt_ids:
-            prompt_ids = [config.bos_token_id]
-        if len(prompt_ids) > config.max_seq_len:
+        ids = list(prompt_ids)
+        if not ids:
+            ids = [config.bos_token_id]
+        if len(ids) > config.max_seq_len:
             # Keep the tail — it holds the latest user turn + generation header.
             logger.warning(
                 "prompt of %d tokens exceeds max_seq_len=%d; left-truncating",
-                len(prompt_ids),
+                len(ids),
                 config.max_seq_len,
             )
-            prompt_ids = prompt_ids[-config.max_seq_len :]
-        prompt_len = len(prompt_ids)
+            ids = ids[-config.max_seq_len :]
+        prompt_len = len(ids)
         bucket = min(_bucket(prompt_len, minimum=32), config.max_seq_len)
+        return ids, prompt_len, bucket
 
-        # Round n up so the data axis divides evenly; trim after.
-        dp = self.data_parallel_size
-        n_padded = ((max(1, n) + dp - 1) // dp) * dp
-
-        eos = list(eos_ids or [config.eos_token_id])[:MAX_EOS_IDS]
-        eos_arr = jnp.array(eos + [-1] * (MAX_EOS_IDS - len(eos)), jnp.int32)
-
-        # Validate before any device work (prefill compiles take seconds).
+    def _validate_constraint(self, constraint, eos: List[int]) -> None:
+        """Reject malformed constraint/eos combinations before any device work
+        (prefill compiles take seconds)."""
         from .schema_constraint import SchemaDFA
         from .token_constraint import TokenConstraint
 
-        if constraint is not None and constraint != "json" and not isinstance(
+        config = self.config
+        if constraint is None:
+            return
+        if constraint != "json" and not isinstance(
             constraint, (SchemaDFA, TokenConstraint)
         ):
             raise ValueError(
@@ -314,9 +337,12 @@ class LocalEngine:
                     f"model vocab {config.vocab_size} < constraint vocab "
                     f"{constraint.vocab_size}"
                 )
-            if any(0 <= e < constraint.vocab_size and constraint.token_len[e] > 0 for e in eos):
+            if any(
+                0 <= e < constraint.vocab_size and constraint.token_len[e] > 0
+                for e in eos
+            ):
                 raise ValueError("eos ids must be special tokens under a TokenConstraint")
-        elif constraint is not None:
+        else:
             # The byte masks treat token ids 0..255 AS bytes — the caller must
             # use a byte-level tokenizer (TpuBackend gates on is_byte_level).
             # Specials (eos/pad) must live above the byte range, or the eos
@@ -327,21 +353,51 @@ class LocalEngine:
                     "with eos/pad ids outside the 0..255 byte range"
                 )
 
+    # -- public API -------------------------------------------------------
+    def generate(
+        self,
+        prompt_ids: Sequence[int],
+        n: int = 1,
+        max_new_tokens: int = 128,
+        temperature: float = 1.0,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        seed: Optional[int] = None,
+        eos_ids: Optional[Sequence[int]] = None,
+        constraint: Optional[str] = None,
+    ) -> GenerationResult:
+        config = self.config
+        prompt_ids, prompt_len, bucket = self._prep_prompt(prompt_ids)
+
+        # Round n up so the data axis divides evenly; trim after.
+        dp = self.data_parallel_size
+        n_padded = ((max(1, n) + dp - 1) // dp) * dp
+
+        eos = list(eos_ids or [config.eos_token_id])[:MAX_EOS_IDS]
+        eos_arr = jnp.array(eos + [-1] * (MAX_EOS_IDS - len(eos)), jnp.int32)
+
+        self._validate_constraint(constraint, eos)
+
         tokens = jnp.array(
             [prompt_ids + [config.pad_token_id] * (bucket - prompt_len)], jnp.int32
         )
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
-        key = jax.random.key(seed)
+        req_keys = jnp.stack([jax.random.key(seed)])
 
         first_logits, prefix = self._get_prefill(bucket)(
             self.params, tokens, jnp.int32(prompt_len)
         )
         loop = self._get_decode_loop(
-            n_padded, max_new_tokens, temperature, top_p, top_k, constraint
+            1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint
         )
         toks, lps, done = loop(
-            self.params, prefix, jnp.int32(prompt_len), first_logits, key, eos_arr
+            self.params,
+            prefix,
+            jnp.array([prompt_len], jnp.int32),
+            first_logits,
+            req_keys,
+            eos_arr,
         )
 
         # ONE host transfer for all outputs: on relayed/remote device platforms
@@ -363,6 +419,127 @@ class LocalEngine:
             finish_reasons=finish,
             prompt_len=prompt_len,
         )
+
+    def generate_many(
+        self,
+        items: Sequence[GenRequestSpec],
+        *,
+        max_new_tokens: int = 128,
+        temperature: float = 1.0,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        eos_ids: Optional[Sequence[int]] = None,
+        constraint: Optional[str] = None,
+    ) -> List[GenerationResult]:
+        """Decode several same-config requests as ONE batched XLA program.
+
+        This is the cross-request throughput path (the reference's concurrency
+        story is 5 async HTTP workers, `README_TESTS.md:214`): R queued
+        requests with compatible sampling configs coalesce into a single
+        decode of R × n_per rows. Each request's prompt is prefilled once at
+        batch=1 (compile-cached per bucket), the prefix KVs are stacked on a
+        request axis, and every row group attends to its own prefix — prompt
+        KV still stored once per request. Per-request seeds keep their solo
+        sampling streams.
+        """
+        if not items:
+            return []
+        if len(items) == 1:
+            it = items[0]
+            return [
+                self.generate(
+                    it.prompt_ids,
+                    n=it.n,
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    top_p=top_p,
+                    top_k=top_k,
+                    seed=it.seed,
+                    eos_ids=eos_ids,
+                    constraint=constraint,
+                )
+            ]
+
+        config = self.config
+        eos = list(eos_ids or [config.eos_token_id])[:MAX_EOS_IDS]
+        eos_arr = jnp.array(eos + [-1] * (MAX_EOS_IDS - len(eos)), jnp.int32)
+        self._validate_constraint(constraint, eos)
+
+        preps = [self._prep_prompt(it.prompt_ids) for it in items]
+        bucket_max = max(bucket for _, _, bucket in preps)
+
+        # One row count for every request (rows must form equal groups): the
+        # max n, rounded so the data axis divides the total batch evenly.
+        dp = self.data_parallel_size
+        n_per = max(max(1, it.n) for it in items)
+        n_per = ((n_per + dp - 1) // dp) * dp
+
+        first_list, k_list, v_list = [], [], []
+        for ids, prompt_len, bucket in preps:
+            tokens = jnp.array(
+                [ids + [config.pad_token_id] * (bucket - prompt_len)], jnp.int32
+            )
+            fl, pref = self._get_prefill(bucket)(
+                self.params, tokens, jnp.int32(prompt_len)
+            )
+            if bucket < bucket_max:
+                pad = [(0, 0)] * 5
+                pad[2] = (0, bucket_max - bucket)  # masked by prompt_len anyway
+                pref = KVCache(k=jnp.pad(pref.k, pad), v=jnp.pad(pref.v, pad))
+            first_list.append(fl)
+            k_list.append(pref.k)
+            v_list.append(pref.v)
+        # Bucket R to the next power of two so timing-dependent batch sizes hit
+        # a bounded set of compiled programs (coalescing is opportunistic — R
+        # is whatever was queued). Padding replicates the LAST request's
+        # already-prefilled slices; its pad rows are trimmed below and cost
+        # little (decode is weight-streaming-bound, not row-bound).
+        r_pad = 1 << (len(items) - 1).bit_length()
+        extra = r_pad - len(items)
+        if extra:
+            k_list += [k_list[-1]] * extra
+            v_list += [v_list[-1]] * extra
+            first_list += [first_list[-1]] * extra
+        prefix = KVCache(
+            k=jnp.concatenate(k_list, axis=1), v=jnp.concatenate(v_list, axis=1)
+        )
+        first_logits = jnp.concatenate(first_list, axis=0)  # [r_pad, V]
+        lens = [p for _, p, _ in preps] + [preps[-1][1]] * extra
+        prompt_lens = jnp.array(lens, jnp.int32)
+
+        seeds = [
+            it.seed if it.seed is not None else int.from_bytes(os.urandom(4), "little")
+            for it in items
+        ]
+        seeds += [0] * extra
+        req_keys = jnp.stack([jax.random.key(s) for s in seeds])
+
+        loop = self._get_decode_loop(
+            r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint
+        )
+        toks, lps, done = loop(
+            self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr
+        )
+        toks_np, lps_np, done_np = jax.device_get((toks, lps, done))
+        toks_np, lps_np, done_np = map(np.asarray, (toks_np, lps_np, done_np))
+
+        results: List[GenerationResult] = []
+        for j, (it, (_, prompt_len, _)) in enumerate(zip(items, preps)):
+            lo, n_j = j * n_per, max(1, it.n)
+            t = toks_np[lo : lo + n_j]
+            l = lps_np[lo : lo + n_j]
+            d = done_np[lo : lo + n_j]
+            lengths = (t != config.pad_token_id).sum(axis=1).astype(np.int32)
+            results.append(
+                GenerationResult(
+                    tokens=t,
+                    logprobs=l,
+                    lengths=lengths,
+                    finish_reasons=["stop" if x else "length" for x in d],
+                    prompt_len=prompt_len,
+                )
+            )
+        return results
 
     # -- embeddings (similarity side-channel) -----------------------------
     def _get_embed(self, batch: int, bucket: int):
@@ -389,7 +566,11 @@ class LocalEngine:
         longest = max(len(ids) for ids in token_lists)
         bucket = _bucket(longest, minimum=32)
         dp = self.data_parallel_size
-        batch = ((len(token_lists) + dp - 1) // dp) * dp
+        # Power-of-two batch bucket (then dp-rounded): coalesced embedding
+        # batches arrive with timing-dependent row counts, and the jit cache is
+        # keyed on the exact batch — bucketing bounds the compiled-program set.
+        batch = _bucket(len(token_lists), minimum=8)
+        batch = ((batch + dp - 1) // dp) * dp
 
         tokens = np.full((batch, bucket), config.pad_token_id, np.int32)
         mask = np.zeros((batch, bucket), np.int32)

@@ -1,58 +1,177 @@
-"""Request scheduler: a queue in front of the device mesh.
+"""Request scheduler: a coalescing queue in front of the device mesh.
 
 The reference's async client just multiplexes HTTP (SURVEY.md §3.3); a local
 engine owns actual hardware, so concurrent callers need ordering: one worker
 thread drains a FIFO queue and runs device work serially (the chip is serial
 anyway — interleaving jit dispatches from many threads only causes duplicate
-compiles and contention). Callers get ``concurrent.futures.Future``s;
-``AsyncKLLMs`` awaits them without blocking the event loop. Queue depth and
-service counts are exposed for observability.
+compiles and contention).
+
+Cross-request batching (the local answer to the reference's 5-async-worker
+concurrency baseline, `README_TESTS.md:214`): work submitted via
+``submit_batched`` carries a compatibility key; when the worker dequeues such
+an item it drains the CONTIGUOUS run of queued items with the same key and
+hands them to one batch runner — e.g. ``LocalEngine.generate_many`` decoding
+several requests in a single XLA program. Coalescing is opportunistic (no
+artificial wait): requests that queue up while the chip is busy ride the next
+batch; a lone request runs solo at unchanged latency.
+
+Callers get ``concurrent.futures.Future``s; ``AsyncKLLMs`` awaits them without
+blocking the event loop. Queue depth and service counts are exposed for
+observability.
 """
 
 from __future__ import annotations
 
 import logging
-import queue
 import threading
+from collections import deque
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 
-class EngineScheduler:
-    """Serializes closures onto one worker thread; thread-safe submit."""
+def _next_pow2(n: int) -> int:
+    return 1 << (max(1, n) - 1).bit_length()
 
-    def __init__(self, name: str = "engine"):
-        self._queue: "queue.Queue[Optional[tuple[Future, Callable[[], Any]]]]" = queue.Queue()
+
+class _Item:
+    __slots__ = ("future", "fn", "batch_key", "payload", "batch_fn", "weight")
+
+    def __init__(
+        self, future, fn=None, batch_key=None, payload=None, batch_fn=None, weight=1
+    ):
+        self.future = future
+        self.fn = fn
+        self.batch_key = batch_key
+        self.payload = payload
+        self.batch_fn = batch_fn
+        self.weight = weight
+
+
+class EngineScheduler:
+    """Serializes closures onto one worker thread; thread-safe submit; queued
+    same-key batched submissions coalesce into one runner call.
+
+    ``max_batch`` caps the number of coalesced requests; ``max_rows`` caps the
+    projected device batch. Coalesced decode pads every member to the group's
+    max weight (rows are equal-size request groups), so the projected cost of
+    a group is ``len(group) * max(weight)`` — a group stops growing once
+    admitting the next item would push that product past ``max_rows``. This
+    bounds HBM: five queued n=32 consensus requests do NOT fuse into one
+    160-row decode."""
+
+    def __init__(self, name: str = "engine", max_batch: int = 8, max_rows: int = 64):
+        self._items: "deque[Optional[_Item]]" = deque()
+        self._cv = threading.Condition()
         self._served = 0
         self._errors = 0
-        self._lock = threading.Lock()
+        self._batches = 0
+        self._coalesced = 0
+        self.max_batch = max_batch
+        self.max_rows = max_rows
         self._worker = threading.Thread(
             target=self._run, name=f"kllms-{name}-worker", daemon=True
         )
         self._worker.start()
 
+    # -- worker -----------------------------------------------------------
+    def _next_group(self) -> Optional[List[_Item]]:
+        """Blocks for the next unit of work: a single closure item, or the
+        contiguous head run of batched items sharing one batch_key."""
+        with self._cv:
+            while not self._items:
+                self._cv.wait()
+            head = self._items.popleft()
+            if head is None:
+                return None
+            if head.batch_key is None:
+                return [head]
+            group = [head]
+            max_w = head.weight
+            while (
+                len(group) < self.max_batch
+                and self._items
+                and self._items[0] is not None
+                and self._items[0].batch_key == head.batch_key
+                # Conservative projected cost: the decode pads the request
+                # count to a power of two (generate_many's compile bucketing),
+                # so admit against next_pow2(len+1) * max weight. Callers pass
+                # weights already rounded to their device-batch granularity.
+                and _next_pow2(len(group) + 1) * max(max_w, self._items[0].weight)
+                <= self.max_rows
+            ):
+                nxt = self._items.popleft()
+                max_w = max(max_w, nxt.weight)
+                group.append(nxt)
+            return group
+
     def _run(self) -> None:
         while True:
-            item = self._queue.get()
-            if item is None:
+            group = self._next_group()
+            if group is None:
                 return
-            future, fn = item
-            if not future.set_running_or_notify_cancel():
+            live = [it for it in group if it.future.set_running_or_notify_cancel()]
+            if not live:
                 continue
             try:
-                future.set_result(fn())
-                with self._lock:
-                    self._served += 1
-            except BaseException as e:  # deliver to the caller, keep serving
-                with self._lock:
-                    self._errors += 1
-                future.set_exception(e)
+                if live[0].batch_key is None:
+                    live[0].future.set_result(live[0].fn())
+                else:
+                    results = live[0].batch_fn([it.payload for it in live])
+                    if len(results) != len(live):  # pragma: no cover - runner bug
+                        raise RuntimeError(
+                            f"batch runner returned {len(results)} results "
+                            f"for {len(live)} requests"
+                        )
+                    for it, res in zip(live, results):
+                        it.future.set_result(res)
+                with self._cv:
+                    self._served += len(live)
+                    if live[0].batch_key is not None:
+                        self._batches += 1
+                        self._coalesced += len(live) - 1
+            except BaseException as e:  # deliver to the caller(s), keep serving
+                with self._cv:
+                    self._errors += len(live)
+                for it in live:
+                    if not it.future.done():
+                        it.future.set_exception(e)
+
+    # -- submission -------------------------------------------------------
+    def _put(self, item: Optional[_Item]) -> None:
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
 
     def submit(self, fn: Callable[[], Any]) -> Future:
         future: Future = Future()
-        self._queue.put((future, fn))
+        self._put(_Item(future, fn=fn))
+        return future
+
+    def submit_batched(
+        self,
+        batch_key: Tuple,
+        payload: Any,
+        batch_fn: Callable[[List[Any]], List[Any]],
+        weight: int = 1,
+    ) -> Future:
+        """Enqueue ``payload`` for batched service. Items whose ``batch_key``
+        matches the queue head's coalesce into ONE ``batch_fn(payloads)`` call
+        (the runner must return one result per payload, in order). Callers with
+        equal keys must pass interchangeable runners — the group uses the first
+        item's. ``weight`` is the item's device-batch contribution (e.g. its
+        sample count n) for the ``max_rows`` admission bound."""
+        future: Future = Future()
+        self._put(
+            _Item(
+                future,
+                batch_key=batch_key,
+                payload=payload,
+                batch_fn=batch_fn,
+                weight=weight,
+            )
+        )
         return future
 
     def call(self, fn: Callable[[], Any]) -> Any:
@@ -63,15 +182,29 @@ class EngineScheduler:
             return fn()
         return self.submit(fn).result()
 
+    def call_batched(
+        self,
+        batch_key: Tuple,
+        payload: Any,
+        batch_fn: Callable[[List[Any]], List[Any]],
+        weight: int = 1,
+    ) -> Any:
+        """Synchronous batched submit-and-wait (re-entrant like ``call``)."""
+        if threading.current_thread() is self._worker:
+            return batch_fn([payload])[0]
+        return self.submit_batched(batch_key, payload, batch_fn, weight=weight).result()
+
     @property
     def stats(self) -> Dict[str, int]:
-        with self._lock:
+        with self._cv:
             return {
-                "queued": self._queue.qsize(),
+                "queued": len(self._items),
                 "served": self._served,
                 "errors": self._errors,
+                "batches": self._batches,
+                "coalesced": self._coalesced,
             }
 
     def shutdown(self) -> None:
-        self._queue.put(None)
+        self._put(None)
         self._worker.join(timeout=5)

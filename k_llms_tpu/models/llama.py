@@ -169,15 +169,19 @@ def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
 
 
 def _gqa_scores_shared(q: jax.Array, k: jax.Array) -> jax.Array:
-    """Shared-prefix scores: q [B, Sq, QH, D] vs ONE key set k [1, Sk, KVH, D].
-    The prefix KV is stored once and broadcast across the n samples — no
-    materialized per-sample copies (the HBM saving behind n=32 on one chip)."""
+    """Shared-prefix scores: q [B, Sq, QH, D] vs R shared key sets
+    k [R, Sk, KVH, D], batch rows grouped request-major (row b belongs to
+    request b // (B//R)). Each prefix is stored ONCE and shared by its
+    request's samples via a reshaped einsum — no materialized per-sample
+    copies (the HBM saving behind n=32 on one chip), and no gather when
+    several requests decode coalesced in one batch. R=1 is the single-request
+    case (one prompt broadcast over all n samples)."""
     B, Sq, QH, D = q.shape
-    KVH = k.shape[2]
+    R, Sk, KVH, _ = k.shape
     G = QH // KVH
-    qg = q.reshape(B, Sq, KVH, G, D)
-    scores = jnp.einsum("bqhgd,khd->bhgqk", qg, k[0], preferred_element_type=jnp.float32)
-    return scores.reshape(B, QH, Sq, k.shape[1])
+    qg = q.reshape(R, B // R, Sq, KVH, G, D)
+    scores = jnp.einsum("rnqhgd,rkhd->rnhgqk", qg, k, preferred_element_type=jnp.float32)
+    return scores.reshape(B, QH, Sq, Sk)
 
 
 def _gqa_values(weights: jax.Array, v: jax.Array) -> jax.Array:
@@ -195,12 +199,13 @@ def _gqa_values(weights: jax.Array, v: jax.Array) -> jax.Array:
 
 
 def _gqa_values_shared(weights: jax.Array, v: jax.Array) -> jax.Array:
-    """weights: [B, QH, Sq, Sk], shared v: [1, Sk, KVH, D] -> [B, Sq, QH, D] f32."""
+    """weights: [B, QH, Sq, Sk], R shared value sets v: [R, Sk, KVH, D] ->
+    [B, Sq, QH, D] f32. Row grouping mirrors :func:`_gqa_scores_shared`."""
     B, QH, Sq, Sk = weights.shape
-    KVH = v.shape[2]
+    R, _, KVH, _ = v.shape
     G = QH // KVH
-    wg = weights.astype(v.dtype).reshape(B, KVH, G, Sq, Sk)
-    out = jnp.einsum("bhgqk,khd->bqhgd", wg, v[0], preferred_element_type=jnp.float32)
+    wg = weights.astype(v.dtype).reshape(R, B // R, KVH, G, Sq, Sk)
+    out = jnp.einsum("rnhgqk,rkhd->rnqhgd", wg, v, preferred_element_type=jnp.float32)
     return out.reshape(B, Sq, QH, v.shape[3])
 
 
@@ -498,23 +503,30 @@ def decode_step(
     gen_cache: KVCache,
     prefix: KVCache,
 ) -> Tuple[jax.Array, KVCache]:
-    """One decode step for all n samples against the shared prefix.
+    """One decode step for all samples against their shared prefix(es).
 
     token: [B] current tokens; step: scalar decode index (0-based); prompt_len:
-    scalar; gen_cache: [L, B, G, KVH, D]; prefix: [L, 1, P, KVH, D].
+    scalar, or [R] vector of per-request prompt lengths when R coalesced
+    requests decode together (rows grouped request-major, B % R == 0);
+    gen_cache: [L, B, G, KVH, D]; prefix: [L, R, P, KVH, D].
     Returns (logits f32 [B, V], updated gen_cache).
     """
     B = token.shape[0]
     G = gen_cache.max_len
     P = prefix.max_len
 
-    positions = (prompt_len + step) * jnp.ones((B, 1), jnp.int32)
+    # Per-ROW prompt length: scalar (legacy single-request) broadcasts to all
+    # rows; an [R] vector repeats over each request's contiguous row group.
+    pl = jnp.asarray(prompt_len, jnp.int32).reshape(-1)
+    pl_row = jnp.repeat(pl, B // pl.shape[0], total_repeat_length=B)  # [B]
+
+    positions = (pl_row + step)[:, None]
     x = _embed(config, params, token[:, None])
 
     # Self (generated) keys: slots 0..step inclusive are valid after the write.
     self_mask = (jnp.arange(G)[None, None, :] <= step) & jnp.ones((B, 1, 1), bool)
-    # Prefix keys: positions < prompt_len are valid.
-    prefix_mask = (jnp.arange(P)[None, None, :] < prompt_len) & jnp.ones((1, 1, 1), bool)
+    # Prefix keys: positions < the row's prompt_len are valid.
+    prefix_mask = jnp.arange(P)[None, None, :] < pl_row[:, None, None]
     self_mask_global = prefix_mask_global = None
     if config.sliding_window is not None:
         # Query position is prompt_len + step; key position k is visible iff
@@ -523,7 +535,9 @@ def decode_step(
         if config.sliding_window_layers == "alternating":
             self_mask_global, prefix_mask_global = self_mask, prefix_mask
         self_mask = self_mask & (jnp.arange(G)[None, None, :] > step - W)
-        prefix_mask = prefix_mask & (jnp.arange(P)[None, None, :] > prompt_len + step - W)
+        prefix_mask = prefix_mask & (
+            jnp.arange(P)[None, None, :] > pl_row[:, None, None] + step - W
+        )
 
     x, gen_cache = _apply_stack(
         config,

@@ -19,12 +19,16 @@ import jax.numpy as jnp
 
 def sample_logits(
     logits: jax.Array,
-    key: jax.Array,
+    key: Optional[jax.Array],
     temperature: float = 1.0,
     top_p: Optional[float] = None,
     top_k: Optional[int] = None,
+    row_keys: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Sample next tokens. logits: [B, V] f32; key: one PRNG key, folded per row.
+    ``row_keys`` ([B] typed keys) overrides the internal per-row fold — the
+    coalesced multi-request decode path derives each row's key from its OWN
+    request seed so per-request draws don't depend on batch composition.
 
     Returns (tokens [B] int32, logprobs [B] f32 — log p(token) under the
     untempered model distribution).
@@ -60,7 +64,10 @@ def sample_logits(
             )
             sampling_logits = jnp.where(sampling_logits < threshold, -jnp.inf, sampling_logits)
 
-        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, jnp.arange(B))
+        if row_keys is None:
+            keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, jnp.arange(B))
+        else:
+            keys = row_keys
         tokens = jax.vmap(lambda k, l: jax.random.categorical(k, l))(keys, sampling_logits)
         tokens = tokens.astype(jnp.int32)
 

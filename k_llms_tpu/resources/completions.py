@@ -83,9 +83,12 @@ class Completions:
         self._wrapper = wrapper
 
     def _scorer(self, settings: ConsensusSettings) -> SimilarityScorer:
-        return SimilarityScorer(
-            method=settings.string_similarity_method,
-            embed_fn=self._wrapper.backend.embeddings,
+        # Shared per-backend scorer: similarity/embedding TTL caches persist
+        # across requests (the reference's caches are module-global,
+        # `consensus_utils.py:620-623`), so repeated extraction workloads do
+        # not re-embed the same strings every call.
+        return self._wrapper.backend.similarity_scorer(
+            settings.string_similarity_method
         )
 
     def create(

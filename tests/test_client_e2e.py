@@ -152,3 +152,51 @@ def test_get_embeddings_helper():
     assert len(embs[0]) == len(embs[1]) > 0
     # deterministic
     assert client.get_embeddings(["alpha"])[0] == embs[0]
+
+
+def test_similarity_caches_shared_across_requests():
+    """The backend owns one scorer per similarity method, so a second identical
+    request hits the embedding/similarity TTL caches and issues ZERO embedding
+    forwards (the reference amortizes via module-global caches,
+    `consensus_utils.py:620-623`)."""
+    from k_llms_tpu.backends.fake import FakeBackend
+
+    long_a = (
+        "The quick brown fox jumps over the extremely lazy dog near the "
+        "riverbank just before dawn on a cold morning."
+    )
+    long_b = (
+        "The quick brown fox leaps over the extremely lazy dog near the "
+        "riverbank just before dawn on a cold morning."
+    )
+
+    class CountingBackend(FakeBackend):
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self.embed_calls = 0
+
+        def embeddings(self, texts):
+            self.embed_calls += 1
+            return super().embeddings(texts)
+
+    contents = [
+        json.dumps({"summary": long_a}),
+        json.dumps({"summary": long_a}),
+        json.dumps({"summary": long_b}),
+    ]
+    backend = CountingBackend(responses=[contents])
+    client = KLLMs(backend=backend, model="m")
+    msgs = [{"role": "user", "content": "q"}]
+
+    first = client.chat.completions.create(messages=msgs, model="m", n=3)
+    calls_after_first = backend.embed_calls
+    assert calls_after_first > 0  # the >50-char strings went through embeddings
+
+    second = client.chat.completions.create(messages=msgs, model="m", n=3)
+    assert backend.embed_calls == calls_after_first
+    assert second.choices[0].message.content == first.choices[0].message.content
+
+    # A separate client over the SAME backend also shares the caches.
+    other = KLLMs(backend=backend, model="m")
+    other.chat.completions.create(messages=msgs, model="m", n=3)
+    assert backend.embed_calls == calls_after_first

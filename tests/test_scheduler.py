@@ -70,3 +70,110 @@ def test_concurrent_async_clients_share_engine():
     assert len(results) == 4
     for r in results:
         assert len(r.choices) == 3
+
+
+# ---------------------------------------------------------------------------
+# Cross-request coalescing (submit_batched)
+# ---------------------------------------------------------------------------
+
+def test_submit_batched_coalesces_same_key():
+    sched = EngineScheduler(name="tb")
+    gate = threading.Event()
+    calls = []
+
+    def runner(payloads):
+        calls.append(list(payloads))
+        return [p * 2 for p in payloads]
+
+    # Occupy the worker so the batched items pile up in the queue.
+    blocker = sched.submit(gate.wait)
+    futs = [sched.submit_batched(("k",), i, runner) for i in range(5)]
+    gate.set()
+    assert [f.result(timeout=5) for f in futs] == [0, 2, 4, 6, 8]
+    blocker.result(timeout=5)
+    assert calls == [[0, 1, 2, 3, 4]]  # ONE runner call served all five
+    stats = sched.stats
+    assert stats["batches"] == 1
+    assert stats["coalesced"] == 4
+    sched.shutdown()
+
+
+def test_submit_batched_respects_key_boundaries():
+    sched = EngineScheduler(name="tb2")
+    gate = threading.Event()
+    calls = []
+
+    def runner(payloads):
+        calls.append(list(payloads))
+        return list(payloads)
+
+    blocker = sched.submit(gate.wait)
+    futs = [
+        sched.submit_batched(("a",), 1, runner),
+        sched.submit_batched(("a",), 2, runner),
+        sched.submit_batched(("b",), 3, runner),
+        sched.submit_batched(("a",), 4, runner),
+    ]
+    gate.set()
+    assert [f.result(timeout=5) for f in futs] == [1, 2, 3, 4]
+    blocker.result(timeout=5)
+    # Only the CONTIGUOUS head run coalesces: [1,2], then [3], then [4].
+    assert calls == [[1, 2], [3], [4]]
+    sched.shutdown()
+
+
+def test_submit_batched_caps_batch_size():
+    sched = EngineScheduler(name="tb3", max_batch=3)
+    gate = threading.Event()
+    calls = []
+
+    def runner(payloads):
+        calls.append(list(payloads))
+        return list(payloads)
+
+    blocker = sched.submit(gate.wait)
+    futs = [sched.submit_batched(("k",), i, runner) for i in range(7)]
+    gate.set()
+    [f.result(timeout=5) for f in futs]
+    blocker.result(timeout=5)
+    assert [len(c) for c in calls] == [3, 3, 1]
+    sched.shutdown()
+
+
+def test_submit_batched_error_reaches_every_caller():
+    sched = EngineScheduler(name="tb4")
+    gate = threading.Event()
+
+    def runner(payloads):
+        raise RuntimeError("batch exploded")
+
+    blocker = sched.submit(gate.wait)
+    futs = [sched.submit_batched(("k",), i, runner) for i in range(3)]
+    gate.set()
+    blocker.result(timeout=5)
+    for f in futs:
+        with pytest.raises(RuntimeError, match="batch exploded"):
+            f.result(timeout=5)
+    assert sched.stats["errors"] == 3
+    sched.shutdown()
+
+
+def test_submit_batched_row_budget():
+    """Groups stop growing when projected rows (len * max weight) would exceed
+    max_rows — five n=32 requests must NOT fuse into one 160-row decode."""
+    sched = EngineScheduler(name="tb5", max_rows=64)
+    gate = threading.Event()
+    calls = []
+
+    def runner(payloads):
+        calls.append(list(payloads))
+        return list(payloads)
+
+    blocker = sched.submit(gate.wait)
+    futs = [sched.submit_batched(("k",), i, runner, weight=32) for i in range(5)]
+    gate.set()
+    [f.result(timeout=5) for f in futs]
+    blocker.result(timeout=5)
+    # 2 * 32 = 64 rows per group at most.
+    assert [len(c) for c in calls] == [2, 2, 1]
+    sched.shutdown()
