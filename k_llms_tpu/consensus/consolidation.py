@@ -121,7 +121,7 @@ def _consensus_over_contents(
                 scorer,
                 consensus_settings.min_support_ratio,
             )
-        contents = [(d if isinstance(d, dict) else {}) for d in aligned_seq]
+        contents = list(aligned_seq)
     return consensus_values(
         contents,
         consensus_settings,
@@ -129,6 +129,39 @@ def _consensus_over_contents(
         llm_consensus_fn=llm_consensus_fn,
         weights=weights if consensus_settings.likelihood_weighting else None,
     )
+
+
+def _consensus_with_degrade(
+    contents: List[Any],
+    texts: List[str],
+    scorer: SimilarityScorer,
+    consensus_settings: ConsensusSettings,
+    llm_consensus_fn: Optional[LlmConsensusFn],
+    weights: Optional[List[float]] = None,
+):
+    """Consensus with the wire-contract crash-rescue: when top-level contents
+    are bare JSON primitives/lists (a model answering "5" or "[1, 2]"), the
+    likelihood structure is not the dict ``KLLMsChatCompletion`` requires —
+    the reference CRASHES here (`types/completions.py:13-15`). Degrade such
+    content to free-text consensus ({"text": ...}), the same treatment
+    non-JSON content gets; if even that yields nothing (all samples empty),
+    fall back to (None, None) — likelihoods is Optional on the wire."""
+    consensus_content, likelihoods = _consensus_over_contents(
+        contents, scorer, consensus_settings, llm_consensus_fn, weights=weights
+    )
+    if isinstance(likelihoods, dict):
+        return consensus_content, likelihoods
+    if texts:
+        consensus_content, likelihoods = _consensus_over_contents(
+            [{"text": t} for t in texts],
+            scorer,
+            consensus_settings,
+            llm_consensus_fn,
+            weights=weights,
+        )
+        if isinstance(likelihoods, dict):
+            return consensus_content, likelihoods
+    return None, None
 
 
 def consolidate_chat_completions(
@@ -154,8 +187,13 @@ def consolidate_chat_completions(
             if used:
                 choice_contents.append(_safe_parse_content(choice.message.content))
 
-        consensus_content, likelihoods = _consensus_over_contents(
+        consensus_content, likelihoods = _consensus_with_degrade(
             choice_contents,
+            [
+                str(choice.message.content)
+                for choice, used in zip(completion.choices, used_mask)
+                if used
+            ],
             scorer,
             consensus_settings,
             llm_consensus_fn,
@@ -205,8 +243,16 @@ def consolidate_chat_completions(
         if completion.choices and completion.choices[0].message.content:
             completion_contents.append(_safe_parse_content(completion.choices[0].message.content))
 
-    consensus_content, likelihoods = _consensus_over_contents(
-        completion_contents, scorer, consensus_settings, llm_consensus_fn
+    consensus_content, likelihoods = _consensus_with_degrade(
+        completion_contents,
+        [
+            str(c.choices[0].message.content)
+            for c in completion_list
+            if c.choices and c.choices[0].message.content
+        ],
+        scorer,
+        consensus_settings,
+        llm_consensus_fn,
     )
 
     base_completion = completion_list[0]
@@ -266,8 +312,13 @@ def consolidate_parsed_chat_completions(
         if used:
             parsed_choice_contents.append(_safe_parse_content(choice.message.content))
 
-    consensus_content, likelihoods = _consensus_over_contents(
+    consensus_content, likelihoods = _consensus_with_degrade(
         parsed_choice_contents,
+        [
+            str(choice.message.content)
+            for choice, used in zip(completion.choices, used_mask)
+            if used
+        ],
         scorer,
         consensus_settings,
         llm_consensus_fn,

@@ -200,3 +200,22 @@ def test_similarity_caches_shared_across_requests():
     other = KLLMs(backend=backend, model="m")
     other.chat.completions.create(messages=msgs, model="m", n=3)
     assert backend.embed_calls == calls_after_first
+
+
+def test_bare_primitive_json_contents_degrade_to_text():
+    """A model answering bare JSON primitives ("5", "[1, 2]") must not crash
+    the likelihoods contract (the reference DOES crash here — its likelihoods
+    field requires a dict): such contents degrade to free-text consensus."""
+    client = make_client(["5", "5", "7"])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3
+    )
+    assert resp.choices[0].message.content == "5"
+    assert resp.likelihoods == {"text": round(2 / 3, 5)}
+
+    client = make_client(["[1, 2]", "[1, 2]", "[1, 2]"])
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=3
+    )
+    assert resp.choices[0].message.content == "[1, 2]"
+    assert resp.likelihoods == {"text": 1.0}

@@ -1,0 +1,146 @@
+"""Differential validation of the EMBEDDINGS similarity route (weak spot #4
+from round 1): consensus *outcomes* — not just the Levenshtein fallback — must
+match the reference engine when both sides use the same embedding provider.
+
+Both engines get the identical deterministic embedder (the fake backend's
+hash-based vectors), so any divergence is in the similarity plumbing: the
+>50-char gate, cosine normalization, cache behavior, alignment thresholds fed
+by embedding similarities, and medoid election over them."""
+
+import json
+
+import numpy as np
+import pytest
+
+from k_llms_tpu.backends.fake import deterministic_embedding
+from k_llms_tpu.consensus.recursion import consensus_values, recursive_list_alignments
+from k_llms_tpu.consensus.settings import ConsensusSettings
+from k_llms_tpu.consensus.similarity import SimilarityScorer
+
+from reference_oracle import load_reference_engine, reference_available
+
+pytestmark = pytest.mark.skipif(
+    not reference_available(), reason="reference tree not present"
+)
+
+LONG = {
+    "a": "The shipment of industrial widgets departed the Rotterdam warehouse "
+    "on Tuesday morning and is expected at the Hamburg depot within three days.",
+    "a2": "The shipment of industrial widgets left the Rotterdam warehouse on "
+    "Tuesday morning and should reach the Hamburg depot within three days.",
+    "b": "Payment terms are net thirty days from the invoice issue date, with a "
+    "two percent discount applied for settlement within ten calendar days.",
+    "c": "All customer support inquiries should be directed to the billing "
+    "department via email and will be answered within two business days.",
+}
+
+
+def embed_fn(texts):
+    return [deterministic_embedding(t) for t in texts]
+
+
+def _run_ours(samples):
+    scorer = SimilarityScorer(method="embeddings", embed_fn=embed_fn)
+    settings = ConsensusSettings(string_similarity_method="embeddings")
+    aligned, _ = recursive_list_alignments(samples, scorer, settings.min_support_ratio)
+    return consensus_values(aligned, settings, scorer)
+
+
+def _run_reference(samples):
+    ref = load_reference_engine()
+    # The reference caches similarities in module-global TTL caches; clear them
+    # so each case is computed fresh.
+    ref.embeddings_cache.clear()
+    ref.similarity_cache.clear()
+    settings = ref.ConsensusSettings(string_similarity_method="embeddings")
+    aligned, _ = ref.recursive_list_alignments(
+        samples, "embeddings", embed_fn, None, settings.min_support_ratio
+    )
+    return ref.consensus_values(aligned, settings, embed_fn, None)
+
+
+def _assert_deep_close(a, b, path=""):
+    assert type(a) is type(b) or (
+        isinstance(a, (int, float)) and isinstance(b, (int, float))
+    ), f"type mismatch at {path}: {type(a)} vs {type(b)}"
+    if isinstance(a, dict):
+        assert set(a) == set(b), f"key mismatch at {path}: {set(a)} vs {set(b)}"
+        for k in a:
+            _assert_deep_close(a[k], b[k], f"{path}.{k}")
+    elif isinstance(a, list):
+        assert len(a) == len(b), f"length mismatch at {path}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_deep_close(x, y, f"{path}[{i}]")
+    elif isinstance(a, float) and not isinstance(a, bool):
+        np.testing.assert_allclose(a, b, rtol=1e-9, atol=1e-12, err_msg=path)
+    else:
+        assert a == b, f"value mismatch at {path}: {a!r} vs {b!r}"
+
+
+def _both(samples):
+    ours_val, ours_conf = _run_ours(json.loads(json.dumps(samples)))
+    ref_val, ref_conf = _run_reference(json.loads(json.dumps(samples)))
+    _assert_deep_close(ours_val, ref_val, "value")
+    _assert_deep_close(ours_conf, ref_conf, "confidence")
+    return ours_val, ours_conf
+
+
+def test_long_string_medoid_via_embeddings():
+    samples = [
+        {"summary": LONG["a"]},
+        {"summary": LONG["a2"]},
+        {"summary": LONG["b"]},
+    ]
+    val, conf = _both(samples)
+    # The medoid should be one of the two near-duplicates, chosen by embedding
+    # cosine (levenshtein would agree here, but conf comes from cosine means).
+    assert val["summary"] in (LONG["a"], LONG["a2"])
+
+
+def test_list_alignment_driven_by_embeddings():
+    samples = [
+        {"notes": [LONG["a"], LONG["b"], LONG["c"]]},
+        {"notes": [LONG["b"], LONG["a2"], LONG["c"]]},  # shuffled + variant
+        {"notes": [LONG["c"], LONG["b"], LONG["a"]]},
+    ]
+    val, conf = _both(samples)
+    assert len(val["notes"]) == 3
+
+
+def test_mixed_short_strings_use_fallback_identically():
+    # Short strings stay under the 50-char gate: both sides must take the
+    # Levenshtein fallback INSIDE the embeddings method.
+    samples = [
+        {"city": "Amsterdam", "summary": LONG["a"]},
+        {"city": "Amsterdem", "summary": LONG["a2"]},
+        {"city": "Amsterdam", "summary": LONG["a"]},
+    ]
+    val, conf = _both(samples)
+    assert val["city"] == "Amsterdam"
+
+
+def test_embedding_failure_degrades_identically():
+    calls = {"n": 0}
+
+    def flaky_embed(texts):
+        raise RuntimeError("embedding backend down")
+
+    ours_scorer = SimilarityScorer(method="embeddings", embed_fn=flaky_embed)
+    settings = ConsensusSettings(string_similarity_method="embeddings")
+    samples = [{"summary": LONG["a"]}, {"summary": LONG["a2"]}, {"summary": LONG["b"]}]
+    aligned, _ = recursive_list_alignments(
+        json.loads(json.dumps(samples)), ours_scorer, settings.min_support_ratio
+    )
+    ours_val, ours_conf = consensus_values(aligned, settings, ours_scorer)
+
+    ref = load_reference_engine()
+    ref.embeddings_cache.clear()
+    ref.similarity_cache.clear()
+    rsettings = ref.ConsensusSettings(string_similarity_method="embeddings")
+    raligned, _ = ref.recursive_list_alignments(
+        json.loads(json.dumps(samples)), "embeddings", flaky_embed, None,
+        rsettings.min_support_ratio,
+    )
+    ref_val, ref_conf = ref.consensus_values(raligned, rsettings, flaky_embed, None)
+    _assert_deep_close(ours_val, ref_val, "value")
+    _assert_deep_close(ours_conf, ref_conf, "confidence")
