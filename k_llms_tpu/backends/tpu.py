@@ -45,7 +45,8 @@ class BackendConfig(BaseModel):
     # Model-config overrides
     dtype: Optional[str] = None  # e.g. "bfloat16" | "float32"
     max_seq_len: Optional[int] = None
-    attention_impl: Optional[str] = None  # "xla" | "flash"
+    attention_impl: Optional[str] = None  # prefill: "xla" | "flash"
+    decode_attention_impl: Optional[str] = None  # decode: "xla" | "flash"
     # Weight quantization: None (model dtype) or "int8" (per-channel symmetric;
     # halves decode HBM traffic, fits 8B-class weights on one v5e chip).
     quantization: Optional[str] = None
@@ -65,10 +66,21 @@ class TpuBackend(Backend):
         })
         self.backend_config = cfg
         self.model_name = cfg.model
-        model_config = get_config(cfg.model)
+        try:
+            model_config = get_config(cfg.model)
+        except KeyError:
+            # Not a registered architecture name: a local HF checkpoint dir
+            # carries its own config.json — build the ModelConfig from it.
+            from ..models.loader import config_from_hf
+
+            model_config = (
+                config_from_hf(cfg.checkpoint_path) if cfg.checkpoint_path else None
+            )
+            if model_config is None:
+                raise
         overrides = {
             k: getattr(cfg, k)
-            for k in ("dtype", "max_seq_len", "attention_impl")
+            for k in ("dtype", "max_seq_len", "attention_impl", "decode_attention_impl")
             if getattr(cfg, k) is not None
         }
         if overrides:
