@@ -1,51 +1,87 @@
 """Headline benchmark (driver-run, real TPU).
 
-Measures the BASELINE.md target: n=32 consensus p50 latency vs single-sample
-p50 on a ~1B-param Llama-architecture model, end-to-end through the public
+Measures the BASELINE.md target on the FLAGSHIP configuration: Llama-3-8B
+shape (synthetic int8 weights — no 8B checkpoint asset ships with this repo),
+n=32 consensus p50 latency vs single-sample p50, end-to-end through the public
 ``KLLMs(backend="tpu")`` client (batched decode + on-device embeddings +
-host-side consensus), plus decode tokens/sec/chip.
+host-side consensus). Also reported, so the numbers are auditable rather than
+self-referential:
+
+- decode tokens/sec/chip plus the HBM bytes streamed per decode step and the
+  implied bandwidth utilization (decode is HBM-bound; v5e peak is 819 GB/s);
+- consensus QUALITY on the scripted noise model (field accuracy of consensus
+  vs single sample, the reference's ~0.85 quality bar, README_TESTS.md:212);
+- concurrent-request throughput: 5 concurrent clients vs serial (the
+  reference's 5-worker baseline, README_TESTS.md:214) via the coalescing
+  scheduler.
 
 Prints ONE JSON line:
   metric = n32_consensus_p50_over_single_p50 (lower is better, target < 2.0)
-  vs_baseline = 2.0 / value  (>1.0 means the target is beaten)
+  vs_baseline = 2.0 / value  (>1.0 means the BASELINE.md <2x target is beaten)
 """
 
 import json
 import statistics
+import threading
 import time
 
 import jax
+import numpy as np
 
-RUNS = 5
+RUNS = 3
 MAX_NEW = 64
 N_CONSENSUS = 32
+FLAGSHIP = "llama-3-8b"
+V5E_PEAK_HBM_GBS = 819.0  # public v5e spec: 819 GB/s HBM bandwidth per chip
+
+MESSAGES = [
+    {
+        "role": "user",
+        "content": (
+            "Extract the invoice fields from this document: ACME Corp, "
+            "invoice number INV-2024-00417, issued March 3rd, total due "
+            "$4,310.55, payment terms net 30, contact billing@acme.example."
+        ),
+    }
+]
 
 
-def main() -> None:
+def _tree_bytes(tree) -> int:
+    return sum(x.nbytes for x in jax.tree.leaves(tree))
+
+
+def _decode_hbm_bytes_per_step(engine, n: int, prompt_len: int, max_new: int) -> int:
+    """Bytes a decode step streams from HBM: every non-embedding weight once
+    (the embedding table is only gathered for n rows), plus the shared-prefix
+    KV and (on average over the decode) half the generated KV."""
+    params = engine.params
+    weight_bytes = _tree_bytes(params) - params["embed"].nbytes
+    cfg = engine.config
+    kv_elem = 2 * 2  # k and v, bf16
+    prefix_bytes = cfg.num_layers * prompt_len * cfg.num_kv_heads * cfg.head_dim * kv_elem
+    gen_bytes = (
+        cfg.num_layers * n * (max_new // 2) * cfg.num_kv_heads * cfg.head_dim * kv_elem
+    )
+    return int(weight_bytes + prefix_bytes + gen_bytes)
+
+
+def bench_flagship() -> "tuple[dict, object, object]":
+    """Returns (metrics dict, backend, client) — the backend/client are reused
+    by the concurrency section so the 8B engine initializes once."""
     from k_llms_tpu import KLLMs
     from k_llms_tpu.backends.tpu import TpuBackend
 
-    model = "llama-1b-byte"
-    # int8 weight-only quantization is the flagship serving config: ~1.4x decode
-    # speedup on v5e (HBM-bandwidth-bound decode reads half the bytes).
-    backend = TpuBackend(model=model, max_new_tokens=MAX_NEW, quantization="int8")
-    client = KLLMs(backend=backend, model=model)
-
-    messages = [
-        {
-            "role": "user",
-            "content": (
-                "Extract the invoice fields from this document: ACME Corp, "
-                "invoice number INV-2024-00417, issued March 3rd, total due "
-                "$4,310.55, payment terms net 30, contact billing@acme.example."
-            ),
-        }
-    ]
+    # int8 weight-only quantization is the flagship serving config: decode is
+    # HBM-bandwidth bound, int8 halves the streamed bytes, and 8B-class
+    # weights (~8.6 GB with bf16 embeddings) fit one 16 GB v5e chip beside
+    # the n=32 KV cache.
+    backend = TpuBackend(model=FLAGSHIP, max_new_tokens=MAX_NEW, quantization="int8")
+    client = KLLMs(backend=backend, model=FLAGSHIP)
 
     def run(n: int) -> float:
         t0 = time.perf_counter()
         client.chat.completions.create(
-            messages=messages, model=model, n=n, temperature=0.8, top_p=0.95, seed=1234
+            messages=MESSAGES, model=FLAGSHIP, n=n, temperature=0.8, top_p=0.95, seed=1234
         )
         return time.perf_counter() - t0
 
@@ -59,32 +95,142 @@ def main() -> None:
     p50_consensus = statistics.median(consensus)
     ratio = p50_consensus / p50_single
 
-    # Raw decode throughput (engine-level, excludes host consensus).
+    # Engine-level decode throughput and HBM accounting. Prefill and fixed
+    # dispatch overhead are removed by differencing two decode lengths.
     tok = backend.tokenizer
-    ids = tok.apply_chat_template(messages)
-    backend.engine.generate(ids, n=N_CONSENSUS, max_new_tokens=MAX_NEW, seed=0)
-    t0 = time.perf_counter()
-    result = backend.engine.generate(ids, n=N_CONSENSUS, max_new_tokens=MAX_NEW, seed=7)
-    decode_s = time.perf_counter() - t0
-    tokens_generated = int(result.lengths.sum())
-    tokens_per_sec_chip = tokens_generated / decode_s / max(1, len(jax.devices()))
+    ids = tok.apply_chat_template(MESSAGES, add_generation_prompt=True)
 
+    def engine_time(max_new: int, seed: int) -> float:
+        t0 = time.perf_counter()
+        backend.engine.generate(
+            ids, n=N_CONSENSUS, max_new_tokens=max_new, temperature=0.8, seed=seed
+        )
+        return time.perf_counter() - t0
+
+    engine_time(8, seed=0)  # warm both decode-loop compiles
+    engine_time(MAX_NEW, seed=0)
+    # Median of several differenced pairs: a single host hiccup in one run
+    # must not leak an absurd step time into the headline numbers.
+    diffs = [
+        engine_time(MAX_NEW, seed=7 + i) - engine_time(8, seed=7 + i)
+        for i in range(3)
+    ]
+    step_s = statistics.median(diffs) / (MAX_NEW - 8)
+    if step_s <= 0:
+        raise RuntimeError(f"non-positive decode step time from diffs {diffs}")
+    tokens_per_sec_chip = N_CONSENSUS / step_s / max(1, len(jax.devices()))
+
+    prompt_len = len(ids)
+    bytes_per_step = _decode_hbm_bytes_per_step(
+        backend.engine, N_CONSENSUS, prompt_len, MAX_NEW
+    )
+    bandwidth_util = bytes_per_step / step_s / (V5E_PEAK_HBM_GBS * 1e9)
+
+    return {
+        "model": FLAGSHIP,
+        "quantization": "int8",
+        "device": str(jax.devices()[0]),
+        "params_bytes": int(_tree_bytes(backend.engine.params)),
+        "p50_single_s": round(p50_single, 4),
+        "p50_n32_consensus_s": round(p50_consensus, 4),
+        "ratio": round(ratio, 4),
+        "decode_step_ms": round(step_s * 1000, 3),
+        "decode_tokens_per_sec_chip": round(tokens_per_sec_chip, 1),
+        "hbm_bytes_per_step": bytes_per_step,
+        "hbm_bandwidth_util": round(bandwidth_util, 4),
+        "prompt_tokens": prompt_len,
+        "max_new_tokens": MAX_NEW,
+        "runs": RUNS,
+    }, backend, client
+
+
+def bench_concurrency(backend, client) -> dict:
+    """5 concurrent clients vs the same 5 requests serial, n=4 each — the
+    coalescing scheduler should fuse the concurrent decodes."""
+    N_REQ, N_PER = 5, 4
+    prompts = [f"Summarize item {i}: " + MESSAGES[0]["content"] for i in range(N_REQ)]
+
+    def one(i: int):
+        return client.chat.completions.create(
+            messages=[{"role": "user", "content": prompts[i]}],
+            model=FLAGSHIP,
+            n=N_PER,
+            temperature=0.8,
+            seed=500 + i,
+        )
+
+    # Warm every program shape a 5-request race can hit: the solo decode and
+    # each power-of-two coalesced group size (opportunistic coalescing makes
+    # the group composition timing-dependent; generate_many buckets R to
+    # powers of two precisely so this warm set is exhaustive).
+    from k_llms_tpu.engine.engine import GenRequestSpec
+
+    one(0)
+    tok = backend.tokenizer
+    warm_ids = tok.apply_chat_template(
+        [{"role": "user", "content": prompts[0]}], add_generation_prompt=True
+    )
+    for r in (2, 4, 8):
+        backend.engine.generate_many(
+            [GenRequestSpec(warm_ids, N_PER, i) for i in range(r)],
+            max_new_tokens=backend.default_max_new_tokens,
+            temperature=0.8,
+            eos_ids=tok.stop_ids,
+        )
+
+    def timed_serial() -> float:
+        t0 = time.perf_counter()
+        for i in range(N_REQ):
+            one(i)
+        return time.perf_counter() - t0
+
+    def timed_concurrent() -> float:
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(N_REQ)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    # Two rounds each, best-of (first concurrent round can still catch a
+    # straggler composition).
+    serial_s = min(timed_serial() for _ in range(2))
+    concurrent_s = min(timed_concurrent() for _ in range(2))
+
+    return {
+        "requests": N_REQ,
+        "n_per_request": N_PER,
+        "serial_s": round(serial_s, 4),
+        "concurrent_s": round(concurrent_s, 4),
+        "speedup": round(serial_s / concurrent_s, 3),
+        "scheduler": {
+            k: v for k, v in backend.scheduler.stats.items() if k in ("batches", "coalesced")
+        },
+    }
+
+
+def main() -> None:
+    flagship, backend, client = bench_flagship()
+    concurrency = bench_concurrency(backend, client)
+
+    # Host-side consensus quality on the scripted noise model (hermetic).
+    from k_llms_tpu.utils.quality import consensus_quality_eval
+
+    quality = consensus_quality_eval()
+
+    ratio = flagship["ratio"]
     print(
         json.dumps(
             {
                 "metric": "n32_consensus_p50_over_single_p50",
-                "value": round(ratio, 4),
+                "value": ratio,
                 "unit": "x",
                 "vs_baseline": round(2.0 / ratio, 4),
                 "detail": {
-                    "model": model,
-                    "quantization": "int8",
-                    "device": str(jax.devices()[0]),
-                    "p50_single_s": round(p50_single, 4),
-                    "p50_n32_consensus_s": round(p50_consensus, 4),
-                    "decode_tokens_per_sec_chip": round(tokens_per_sec_chip, 1),
-                    "max_new_tokens": MAX_NEW,
-                    "runs": RUNS,
+                    "flagship": flagship,
+                    "concurrency": concurrency,
+                    "quality": quality,
                 },
             }
         )

@@ -90,11 +90,14 @@ class LocalEngine:
             qspecs = quantized_param_specs(pspecs)
 
         if params is None:
+            if quantize:
+                # Build the int8 tree directly — an 8B bf16 tree (~16 GB)
+                # cannot coexist with its quantized copy in one chip's HBM.
+                from ..models.quant import init_params_quantized
 
-            def init(k):
-                p = init_params(self.config, k)
-                return quantize_params(p) if quantize else p
-
+                init = partial(init_params_quantized, self.config)
+            else:
+                init = partial(init_params, self.config)
             if self.mesh is not None:
                 init = jax.jit(
                     init,
@@ -494,7 +497,9 @@ class LocalEngine:
         # is whatever was queued). Padding replicates the LAST request's
         # already-prefilled slices; its pad rows are trimmed below and cost
         # little (decode is weight-streaming-bound, not row-bound).
-        r_pad = 1 << (len(items) - 1).bit_length()
+        # NB: must stay the scheduler's admission model (_next_pow2 in
+        # scheduler.py) for the max_rows HBM bound to hold.
+        r_pad = _bucket(len(items), minimum=1)
         extra = r_pad - len(items)
         if extra:
             k_list += [k_list[-1]] * extra

@@ -29,8 +29,16 @@ class ModelConfig:
     dtype: str = "bfloat16"
     # Prefill attention implementation: "xla" (einsum, runs anywhere) or
     # "flash" (Pallas TPU kernel, ops/attention.py; ~1.3x prefill attention
-    # speedup at 2k context on v5e). Decode always uses the XLA path (Sq=1).
+    # speedup at 2k context on v5e).
     attention_impl: str = "xla"
+    # Decode-step attention: "xla" (default) or "flash" (Pallas shared-prefix
+    # kernel, ops/attention.py::decode_prefix_attention — streams each prefix
+    # KV block once per request with the whole query tile on the MXU).
+    # Measured on v5e at the 8B/int8/n=32/256-token-prefix flagship config the
+    # kernel is 0.94x of XLA: decode there is WEIGHT-streaming-bound
+    # (8.6 GB/step vs ~34 MB of prefix KV), so kernel call overhead outweighs
+    # the attention win; it's an opt-in for long-prefix regimes.
+    decode_attention_impl: str = "xla"
     # Architecture variants beyond Llama:
     # - qkv_bias: additive bias on q/k/v projections (Qwen2 family).
     # - sliding_window: each query attends only to the last W keys

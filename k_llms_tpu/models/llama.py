@@ -220,14 +220,16 @@ def _block(
     prefix_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
     prefix_mask: Optional[jax.Array] = None,
     key_lengths: Optional[jax.Array] = None,
+    prefix_lengths: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One transformer block over (possibly cached) keys.
 
     x: [B, Sq, H]; kv: layer cache (k, v) each [B, Smax, KVH, D];
     write_index: scalar slot where this call's k/v are written (None = positions
     0..Sq, i.e. prefill); key_mask: [B|1, Sq, Smax] additive-mask booleans for the
-    self cache; prefix_kv/prefix_mask: optional shared-prompt cache [1, P, KVH, D]
-    and [1|B, Sq, P].
+    self cache; prefix_kv/prefix_mask: optional shared-prompt cache [R, P, KVH, D]
+    and [1|B, Sq, P]; prefix_lengths: [R] valid prefix key counts (decode only —
+    enables the Pallas shared-prefix decode kernel).
     """
     B, Sq, H = x.shape
     scale = config.query_scale or 1.0 / math.sqrt(config.head_dim)
@@ -299,6 +301,51 @@ def _block(
         attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
         return mlp(attn_out(attn)), (cache_k, cache_v)
 
+    # Decode step against a shared prefix: the Pallas decode kernel streams
+    # each prefix KV block from HBM once per (request, kv head) and hits it
+    # with the request's whole query tile; the short generated tail plus an
+    # exact logsumexp merge stay in XLA. Gated to tile-friendly shapes
+    # (query rows per request >= one sublane tile).
+    if (
+        config.decode_attention_impl == "flash"
+        and config.sliding_window is None
+        and config.attn_softcap is None
+        and write_index is not None
+        and Sq == 1
+        and prefix_kv is not None
+        and prefix_lengths is not None
+        and (B // prefix_kv[0].shape[0]) * (config.num_heads // config.num_kv_heads) >= 8
+    ):
+        from ..ops.attention import decode_prefix_attention
+
+        pk, pv = prefix_kv
+        out_p, m_p, l_p = decode_prefix_attention(
+            q[:, 0],
+            pk,
+            pv,
+            prefix_lengths,
+            sm_scale=scale,
+            interpret=jax.default_backend() != "tpu",
+        )
+        # Generated-KV tail (tens of keys, per-row) in XLA, unnormalized.
+        s_g = _gqa_scores(q, cache_k) * scale  # [B, QH, 1, G]
+        s_g = jnp.where(key_mask[:, None, :, :], s_g, jnp.finfo(jnp.float32).min)
+        m_g = jnp.max(s_g, axis=-1)[:, :, 0]  # [B, QH]
+        p_g = jnp.exp(s_g - m_g[:, :, None, None])
+        l_g = jnp.sum(p_g, axis=-1)[:, :, 0]
+        out_g = _gqa_values(p_g, cache_v)[:, 0]  # [B, QH, D], sum of p*v
+
+        m = jnp.maximum(m_p, m_g)
+        a_p = jnp.exp(m_p - m)
+        a_g = jnp.exp(m_g - m)
+        denom = l_p * a_p + l_g * a_g
+        merged = (
+            out_p * (l_p * a_p)[..., None] + out_g * a_g[..., None]
+        ) / jnp.where(denom == 0.0, 1.0, denom)[..., None]
+        attn = merged[:, None]  # [B, Sq=1, QH, D]
+        attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
+        return mlp(attn_out(attn)), (cache_k, cache_v)
+
     scores = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, Smax] f32
     if config.attn_softcap is not None:
         scores = _softcap(scores, config.attn_softcap)
@@ -345,6 +392,7 @@ def _apply_stack(
     key_lengths: Optional[jax.Array] = None,
     key_mask_global: Optional[jax.Array] = None,
     prefix_mask_global: Optional[jax.Array] = None,
+    prefix_lengths: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Scan the layer stack. cache k/v: [L, B, Smax, KVH, D].
 
@@ -377,6 +425,7 @@ def _apply_stack(
             prefix_kv=scanned.get("prefix"),
             prefix_mask=pm,
             key_lengths=key_lengths,
+            prefix_lengths=prefix_lengths,
         )
         return x, new_kv
 
@@ -551,6 +600,7 @@ def decode_step(
         prefix_mask=prefix_mask,
         key_mask_global=self_mask_global,
         prefix_mask_global=prefix_mask_global,
+        prefix_lengths=pl,
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     logits = _logits(config, params, h[:, 0, :])

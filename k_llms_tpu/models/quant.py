@@ -89,6 +89,69 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def init_params_quantized(config, key: jax.Array, dtype=None) -> Dict[str, Any]:
+    """Random int8-quantized init, building the QTensor tree DIRECTLY.
+
+    For synthetic flagship benches: an 8B bf16 tree (~16 GB) cannot sit in one
+    v5e chip's HBM next to its int8 copy during quantization, so the usual
+    init-then-quantize path is unusable at that scale. Here the int8 payloads
+    are drawn uniformly and scales are constants chosen so effective weights
+    have ~N(0, 1/fan_in) magnitude (finite logits; a random model is all a
+    synthetic bench needs). Mirrors the tree structure of
+    ``llama.init_params`` + ``quantize_params``.
+    """
+    import math
+
+    dtype = dtype or config.jax_dtype
+    H, I, V = config.hidden_size, config.intermediate_size, config.vocab_size
+    L, Q, KV = config.num_layers, config.q_dim, config.kv_dim
+
+    def qinit(k, shape) -> QTensor:
+        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        # std(uniform int8) = 127/sqrt(3); scale it to 1/sqrt(fan_in).
+        scale_val = math.sqrt(3.0) / (127.0 * math.sqrt(shape[-2]))
+        scale = jnp.full(shape[:-2] + (1, shape[-1]), scale_val, jnp.float32)
+        return QTensor(q=q, scale=scale)
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    ks = jax.random.split(k_layers, 8)
+    norm_init = jnp.zeros if config.norm_offset else jnp.ones
+    layers: Dict[str, Any] = {
+        "attn_norm": norm_init((L, H), dtype),
+        "wq": qinit(ks[0], (L, H, Q)),
+        "wk": qinit(ks[1], (L, H, KV)),
+        "wv": qinit(ks[2], (L, H, KV)),
+        "wo": qinit(ks[3], (L, Q, H)),
+        "mlp_norm": norm_init((L, H), dtype),
+    }
+    if config.num_experts > 0:
+        E = config.num_experts
+        layers["w_router"] = normal(ks[7], (L, H, E), 1.0 / math.sqrt(H))
+        layers["w_gate"] = qinit(ks[4], (L, E, H, I))
+        layers["w_up"] = qinit(ks[5], (L, E, H, I))
+        layers["w_down"] = qinit(ks[6], (L, E, I, H))
+    else:
+        layers["w_gate"] = qinit(ks[4], (L, H, I))
+        layers["w_up"] = qinit(ks[5], (L, H, I))
+        layers["w_down"] = qinit(ks[6], (L, I, H))
+    if config.qkv_bias:
+        layers["bq"] = jnp.zeros((L, Q), dtype)
+        layers["bk"] = jnp.zeros((L, KV), dtype)
+        layers["bv"] = jnp.zeros((L, KV), dtype)
+    if config.post_block_norms:
+        layers["post_attn_norm"] = norm_init((L, H), dtype)
+        layers["post_mlp_norm"] = norm_init((L, H), dtype)
+    return {
+        "embed": normal(k_embed, (V, H), 1.0 / math.sqrt(H)),
+        "layers": layers,
+        "final_norm": norm_init((H,), dtype),
+        "lm_head": qinit(k_head, (H, V)),
+    }
+
+
 def quantized_param_specs(specs: Dict[str, Any]) -> Dict[str, Any]:
     """Map a bf16 param-spec tree to the quantized tree: the int8 payload keeps
     the weight's spec; the scale keeps it too except on the contraction axis

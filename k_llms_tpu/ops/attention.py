@@ -125,6 +125,147 @@ def _flash_kernel(
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
+def _decode_prefix_kernel(
+    keylen_ref,  # [R, 1] int32 in SMEM: valid prefix length per request
+    q_ref,  # [1, KVH, QR, D] — all of one request's query rows, per kv head
+    k_ref,  # [1, block_k, KVH, D]
+    v_ref,  # [1, block_k, KVH, D]
+    o_ref,  # [1, KVH, QR, D] f32 (normalized within the prefix phase)
+    m_o_ref,  # [1, KVH, QR] f32 running max (for the caller's logsumexp merge)
+    l_o_ref,  # [1, KVH, QR] f32 softmax denominator at m
+    acc_ref,  # VMEM scratch [KVH, QR, D] f32
+    m_ref,  # VMEM scratch [KVH, QR] f32
+    l_ref,  # VMEM scratch [KVH, QR] f32
+    *,
+    sm_scale: float,
+    block_k: int,
+    kv_heads: int,
+):
+    # Grid (R, key blocks): every block takes FULL (KVH, D) trailing axes, so
+    # TPU tiling constraints are met for any head count / head dim, each KV
+    # block streams from HBM exactly once, and the kv-head loop unrolls inside
+    # the kernel over VMEM-resident data.
+    r = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    QR = q_ref.shape[2]
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (QR, block_k), 1)
+    valid = cols < keylen_ref[r, 0]
+
+    for h in range(kv_heads):  # static unroll
+        q = q_ref[0, h].astype(jnp.float32)  # [QR, D]
+        k = k_ref[0, :, h, :].astype(jnp.float32)  # [block_k, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = jnp.where(valid, s * sm_scale, NEG_INF)  # [QR, block_k]
+
+        m_prev = m_ref[h][:, None]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[h] = l_ref[h] * alpha[:, 0] + jnp.sum(p, axis=1)
+        acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, :, h, :].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[h] = m_new[:, 0]
+
+    @pl.when(ki == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = acc_ref[:] / safe_l[:, :, None]
+        m_o_ref[0] = m_ref[:]
+        l_o_ref[0] = l_ref[:]
+
+
+def decode_prefix_attention(
+    q: jax.Array,
+    prefix_k: jax.Array,
+    prefix_v: jax.Array,
+    prompt_lens: jax.Array,
+    *,
+    sm_scale: Optional[float] = None,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Decode-step attention over the SHARED-PREFIX KV, as a Pallas kernel.
+
+    The decode hot loop splits attention into (a) the prompt prefix — hundreds
+    of keys, stored once per request and shared by all its samples — and (b)
+    the per-row generated tail (tens of keys). This kernel handles phase (a),
+    where the HBM traffic is: the grid walks (request, kv head, key block) so
+    each prefix block is streamed from HBM ONCE per (request, head) and hit by
+    the request's whole [n_per*G, D] query tile on the MXU — versus one read
+    per batch row in a naive layout. Phase (b) plus an exact logsumexp merge
+    stay in XLA (`models/llama.py::_block`).
+
+    q: [B, QH, D] (rows request-major, B % R == 0); prefix_k/v:
+    [R, P, KVH, D]; prompt_lens: [R] valid key counts. Returns
+    (out [B, QH, D] f32 — normalized within the prefix phase, m [B, QH],
+    l [B, QH]) for the caller's merge.
+    """
+    B, QH, D = q.shape
+    R, P, KVH, _ = prefix_k.shape
+    G = QH // KVH
+    n_per = B // R
+    QR = n_per * G
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    block_k = min(block_k, P)
+
+    # Request-major query tile per kv head: [R, KVH, n_per*G, D]. Row (r, h,
+    # i*G + g) is batch row r*n_per + i, query head h*G + g.
+    q4 = q.reshape(R, n_per, KVH, G, D).transpose(0, 2, 1, 3, 4).reshape(R, KVH, QR, D)
+
+    grid = (R, pl.cdiv(P, block_k))
+    kernel = functools.partial(
+        _decode_prefix_kernel, sm_scale=scale, block_k=block_k, kv_heads=KVH
+    )
+
+    out, m, l = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((R, KVH, QR, D), jnp.float32),
+            jax.ShapeDtypeStruct((R, KVH, QR), jnp.float32),
+            jax.ShapeDtypeStruct((R, KVH, QR), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R, 1), lambda r, ki: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, KVH, QR, D), lambda r, ki: (r, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, KVH, D), lambda r, ki: (r, ki, 0, 0)),
+            pl.BlockSpec((1, block_k, KVH, D), lambda r, ki: (r, ki, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, KVH, QR, D), lambda r, ki: (r, 0, 0, 0)),
+            pl.BlockSpec((1, KVH, QR), lambda r, ki: (r, 0, 0)),
+            pl.BlockSpec((1, KVH, QR), lambda r, ki: (r, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((KVH, QR, D), jnp.float32),
+            pltpu.VMEM((KVH, QR), jnp.float32),
+            pltpu.VMEM((KVH, QR), jnp.float32),
+        ],
+        interpret=interpret,
+    )(prompt_lens.astype(jnp.int32).reshape(R, 1), q4, prefix_k, prefix_v)
+
+    def back(x):  # [R, KVH, QR, ...] -> [B, QH, ...]
+        tail = x.shape[3:]
+        x = x.reshape(R, KVH, n_per, G, *tail).swapaxes(1, 2)
+        return x.reshape(B, QH, *tail)
+
+    return back(out), back(m), back(l)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -187,3 +187,23 @@ def test_generate_many_single_item_delegates(engine, tok):
         [GenRequestSpec(ids, 3, 123)], max_new_tokens=8, temperature=0.9
     )
     assert (solo.tokens == many.tokens).all()
+
+
+def test_flash_decode_matches_xla(tok):
+    """The Pallas shared-prefix decode path reproduces the XLA decode path
+    (greedy, same params)."""
+    from k_llms_tpu.models import get_config, init_params
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    e_xla = LocalEngine(
+        cfg.with_(decode_attention_impl="xla"), params=params, use_mesh=False
+    )
+    e_flash = LocalEngine(
+        cfg.with_(decode_attention_impl="flash"), params=params, use_mesh=False
+    )
+    ids = tok.encode("hello flash decode path")
+    a = e_xla.generate(ids, n=8, max_new_tokens=8, temperature=0.0)
+    b = e_flash.generate(ids, n=8, max_new_tokens=8, temperature=0.0)
+    assert (a.tokens == b.tokens).all()
+    np.testing.assert_allclose(a.logprobs, b.logprobs, rtol=5e-4, atol=5e-4)

@@ -70,3 +70,58 @@ def test_ring_attention_memory_layout():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# Pallas shared-prefix decode attention
+# ---------------------------------------------------------------------------
+
+def _decode_oracle(q, pk, pv, prompt_lens, scale):
+    """Two-phase reference: full softmax over each row's valid prefix keys."""
+    B, QH, D = q.shape
+    R, P, KVH, _ = pk.shape
+    G = QH // KVH
+    n_per = B // R
+    out = np.zeros((B, QH, D), np.float32)
+    m = np.zeros((B, QH), np.float32)
+    l = np.zeros((B, QH), np.float32)
+    for b in range(B):
+        r = b // n_per
+        valid = int(prompt_lens[r])
+        for h in range(QH):
+            kv = h // G
+            s = (
+                np.asarray(q[b, h], np.float32)
+                @ np.asarray(pk[r, :valid, kv], np.float32).T
+            ) * scale
+            mx = s.max()
+            e = np.exp(s - mx)
+            m[b, h] = mx
+            l[b, h] = e.sum()
+            out[b, h] = (e / e.sum()) @ np.asarray(pv[r, :valid, kv], np.float32)
+    return out, m, l
+
+
+@pytest.mark.parametrize("R,n_per,QH,KVH,P", [(1, 8, 4, 2, 32), (4, 2, 8, 2, 64), (2, 4, 4, 4, 160)])
+def test_decode_prefix_attention_matches_oracle(R, n_per, QH, KVH, P):
+    from k_llms_tpu.ops.attention import decode_prefix_attention
+
+    D = 16
+    B = R * n_per
+    key = jax.random.key(0)
+    kq, kk, kv_, kl = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, QH, D), jnp.float32)
+    pk = jax.random.normal(kk, (R, P, KVH, D), jnp.float32)
+    pv = jax.random.normal(kv_, (R, P, KVH, D), jnp.float32)
+    # Ragged valid lengths per request (>=1, <= P), not block-aligned.
+    lens = jax.random.randint(kl, (R,), 1, P + 1)
+
+    out, m, l = decode_prefix_attention(
+        q, pk, pv, lens, sm_scale=0.25, block_k=32, interpret=True
+    )
+    ref_out, ref_m, ref_l = _decode_oracle(
+        np.asarray(q), np.asarray(pk), np.asarray(pv), np.asarray(lens), 0.25
+    )
+    np.testing.assert_allclose(np.asarray(out), ref_out, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m), ref_m, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(l), ref_l, rtol=2e-5, atol=2e-5)
