@@ -26,7 +26,6 @@ import threading
 import time
 
 import jax
-import numpy as np
 
 RUNS = 3
 MAX_NEW = 64
@@ -52,16 +51,20 @@ def _tree_bytes(tree) -> int:
 
 def _decode_hbm_bytes_per_step(engine, n: int, prompt_len: int, max_new: int) -> int:
     """Bytes a decode step streams from HBM: every non-embedding weight once
-    (the embedding table is only gathered for n rows), plus the shared-prefix
-    KV and (on average over the decode) half the generated KV."""
+    (the embedding table is only gathered for n rows), plus the FULL padded KV
+    buckets — the XLA attention reads the whole prefix bucket and the whole
+    generated-cache buffer every step, masked positions included."""
+    from k_llms_tpu.engine.engine import _bucket
+
     params = engine.params
     weight_bytes = _tree_bytes(params) - params["embed"].nbytes
     cfg = engine.config
     kv_elem = 2 * 2  # k and v, bf16
-    prefix_bytes = cfg.num_layers * prompt_len * cfg.num_kv_heads * cfg.head_dim * kv_elem
-    gen_bytes = (
-        cfg.num_layers * n * (max_new // 2) * cfg.num_kv_heads * cfg.head_dim * kv_elem
+    prefix_bucket = min(_bucket(prompt_len, minimum=32), cfg.max_seq_len)
+    prefix_bytes = (
+        cfg.num_layers * prefix_bucket * cfg.num_kv_heads * cfg.head_dim * kv_elem
     )
+    gen_bytes = cfg.num_layers * n * max_new * cfg.num_kv_heads * cfg.head_dim * kv_elem
     return int(weight_bytes + prefix_bytes + gen_bytes)
 
 

@@ -152,7 +152,11 @@ class SimilarityScorer:
 
     # -- containers -------------------------------------------------------
     def dict(self, d1: dict, d2: dict) -> float:
-        all_keys = set(d1.keys()) | set(d2.keys())
+        # Sorted union: a raw set iterates in hash order, which varies with
+        # PYTHONHASHSEED across processes — the float sum below then rounds
+        # differently run to run and downstream threshold/medoid decisions
+        # flip (the reference has this instability; determinism wins here).
+        all_keys = sorted(set(d1.keys()) | set(d2.keys()))
         all_keys = [
             k for k in all_keys if not any(re.match(p, k) for p in IGNORED_KEY_PATTERNS)
         ]

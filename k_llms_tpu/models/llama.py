@@ -221,6 +221,7 @@ def _block(
     prefix_mask: Optional[jax.Array] = None,
     key_lengths: Optional[jax.Array] = None,
     prefix_lengths: Optional[jax.Array] = None,
+    window_value=None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One transformer block over (possibly cached) keys.
 
@@ -276,13 +277,12 @@ def _block(
             out = rms_norm(out, layer["post_attn_norm"], config.rms_eps, offset)
         return x + out
 
-    # Full-sequence prefill can take the Pallas flash path: prefix-length
-    # masking + causal structure are exactly what the kernel supports (softcaps
-    # and windowed layers are not — they keep the XLA path).
+    # Full-sequence prefill takes the Pallas flash path: prefix-length masking,
+    # causal structure, attention softcap (Gemma-2) and sliding windows
+    # (Mistral "all", Gemma-2 "alternating" via a dynamic per-layer window
+    # scalar) are all kernel-supported.
     if (
         config.attention_impl == "flash"
-        and config.sliding_window is None
-        and config.attn_softcap is None
         and write_index is None
         and prefix_kv is None
         and key_lengths is not None
@@ -296,6 +296,8 @@ def _block(
             causal=True,
             key_lengths=key_lengths,
             sm_scale=scale,
+            softcap=config.attn_softcap,
+            window=window_value,
             interpret=jax.default_backend() != "tpu",
         ).transpose(0, 2, 1, 3)
         attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
@@ -407,12 +409,21 @@ def _apply_stack(
         flag = scanned.get("flag")
         if flag is None:
             km, pm = key_mask, prefix_mask
+            # Static per-model window ("all" layers or none).
+            window_value = config.sliding_window
         else:
             km = jnp.where(flag, key_mask, key_mask_global)
             pm = (
                 jnp.where(flag, prefix_mask, prefix_mask_global)
                 if prefix_mask is not None
                 else None
+            )
+            # Alternating layers: the scanned flag picks this layer's window
+            # (a traced scalar — the flash kernel takes it dynamically).
+            from ..ops.attention import NO_WINDOW
+
+            window_value = jnp.where(
+                flag, jnp.int32(config.sliding_window), jnp.int32(NO_WINDOW)
             )
         x, new_kv = _block(
             config,
@@ -426,6 +437,7 @@ def _apply_stack(
             prefix_mask=pm,
             key_lengths=key_lengths,
             prefix_lengths=prefix_lengths,
+            window_value=window_value,
         )
         return x, new_kv
 

@@ -56,6 +56,7 @@ def attention_xla(
 
 def _flash_kernel(
     keylen_ref,  # [B, 1] int32 in SMEM: valid (prefix) key count per batch row
+    window_ref,  # [1, 1] int32 in SMEM: sliding window (2^30 = no window)
     q_ref,  # [1, 1, block_q, D]
     k_ref,  # [1, 1, block_k, D]
     v_ref,  # [1, 1, block_k, D]
@@ -68,6 +69,7 @@ def _flash_kernel(
     causal: bool,
     block_q: int,
     block_k: int,
+    softcap: Optional[float],
 ):
     bi = pl.program_id(0)
     qi = pl.program_id(2)
@@ -89,12 +91,17 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         s = s * sm_scale  # [block_q, block_k]
+        if softcap is not None:  # Gemma-2 attention softcap
+            s = softcap * jnp.tanh(s / softcap)
 
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         valid = cols < keylen_ref[bi, 0]
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             valid = jnp.logical_and(valid, cols <= rows)
+        # Sliding window (dynamic so alternating-layer configs can scan one
+        # kernel): query at row sees keys in (row - W, row].
+        valid = jnp.logical_and(valid, cols > rows - window_ref[0, 0])
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:]
@@ -122,7 +129,12 @@ def _flash_kernel(
     def _finalize():
         l = l_ref[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        out = acc_ref[:] / safe_l
+        # A row with NO valid key anywhere (m never left the floor — e.g. a
+        # padded query whose window misses the valid key range entirely)
+        # accumulated exp(0)=1 garbage; emit zeros for it instead.
+        out = jnp.where(m_ref[:] == NEG_INF, 0.0, out)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def _decode_prefix_kernel(
@@ -266,6 +278,9 @@ def decode_prefix_attention(
     return back(out), back(m), back(l)
 
 
+NO_WINDOW = 1 << 30
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -274,6 +289,8 @@ def flash_attention(
     causal: bool = True,
     key_lengths: Optional[jax.Array] = None,
     sm_scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window=None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
@@ -281,7 +298,11 @@ def flash_attention(
     """Pallas flash attention. q: [B, QH, Sq, D]; k/v: [B, KVH, Sk, D];
     key_lengths: [B] int32 — keys at positions >= length are masked (the
     padding pattern our engine produces; a prefix length rides SMEM where an
-    arbitrary mask array would break TPU tiling). Returns [B, QH, Sq, D].
+    arbitrary mask array would break TPU tiling). ``softcap`` applies Gemma-2's
+    cap*tanh(s/cap) to the scaled scores. ``window`` limits each query to the
+    last W keys — a static int or a TRACED scalar, so alternating-window
+    configs (Gemma-2) can select W per scanned layer without recompiling.
+    Returns [B, QH, Sq, D].
 
     Sq/Sk pad to block multiples internally; GQA maps query head h onto kv head
     h // (QH // KVH) via the BlockSpec index maps.
@@ -299,6 +320,9 @@ def flash_attention(
     if key_lengths is None:
         key_lengths = jnp.full((B,), Sk, jnp.int32)
     key_lengths = key_lengths.astype(jnp.int32).reshape(B, 1)
+    if window is None:
+        window = NO_WINDOW
+    window_arr = jnp.asarray(window, jnp.int32).reshape(1, 1)
     if Sk_pad != Sk:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
@@ -313,6 +337,7 @@ def flash_attention(
         causal=causal,
         block_q=block_q,
         block_k=block_k,
+        softcap=softcap,
     )
 
     out = pl.pallas_call(
@@ -321,6 +346,7 @@ def flash_attention(
         grid=grid,
         in_specs=[
             pl.BlockSpec((B, 1), lambda b, h, qi, ki: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda b, h, qi, ki: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
@@ -332,6 +358,6 @@ def flash_attention(
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(key_lengths, q, k, v)
+    )(key_lengths, window_arr, q, k, v)
 
     return out[:, :, :Sq, :]

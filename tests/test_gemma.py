@@ -221,3 +221,27 @@ def test_safetensors_import_gemma_norms(tmp_path):
     np.testing.assert_allclose(
         np.asarray(loaded["lm_head"]), np.asarray(params["embed"]).T
     )
+
+
+def test_gemma_flash_forward_matches_xla():
+    """Flash prefill now covers softcap + alternating windows: the full Gemma-2
+    style forward must match the XLA path."""
+    import numpy as np
+
+    cfg_xla = TINY_GEMMA.with_(attention_impl="xla")
+    cfg_flash = TINY_GEMMA.with_(attention_impl="flash")
+    params = init_params(cfg_xla, jax.random.key(2))
+    S = 24
+    tokens = jax.random.randint(jax.random.key(3), (2, S), 0, cfg_xla.vocab_size)
+    mask = (jnp.arange(S)[None, :] < jnp.array([[S], [17]])).astype(jnp.int32)
+    a, _ = forward(cfg_xla, params, tokens, mask)
+    b, _ = forward(cfg_flash, params, tokens, mask)
+    # Padded query rows whose sliding window misses the valid range entirely
+    # have no defined output (kernel zeroes them, XLA spreads uniform) — only
+    # the valid rows carry semantics.
+    np.testing.assert_allclose(
+        np.asarray(a)[0], np.asarray(b)[0], rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(a)[1, :17], np.asarray(b)[1, :17], rtol=2e-3, atol=2e-3
+    )

@@ -125,3 +125,72 @@ def test_decode_prefix_attention_matches_oracle(R, n_per, QH, KVH, P):
     np.testing.assert_allclose(np.asarray(out), ref_out, rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(m), ref_m, rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(l), ref_l, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_softcap_and_window_matches_xla():
+    """Kernel softcap + sliding-window support against a manually-masked
+    XLA reference."""
+    from k_llms_tpu.ops.attention import flash_attention
+
+    B, QH, KVH, S, D = 2, 4, 2, 64, 16
+    key = jax.random.key(3)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, QH, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, KVH, S, D), jnp.float32)
+    v = jax.random.normal(kv_, (B, KVH, S, D), jnp.float32)
+    lens = jnp.array([S, 37], jnp.int32)
+    W, CAP, scale = 9, 12.0, 0.3
+
+    def oracle():
+        G = QH // KVH
+        qg = q.reshape(B, KVH, G, S, D)
+        s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k) * scale
+        s = CAP * jnp.tanh(s / CAP)
+        rows = jnp.arange(S)[:, None]
+        cols = jnp.arange(S)[None, :]
+        mask = (cols <= rows) & (cols > rows - W)
+        mask = mask[None, None, None] & (cols[None] < lens[:, None, None])[:, None, None]
+        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhgqk,bhkd->bhgqd", w, v).reshape(B, QH, S, D)
+
+    out = flash_attention(
+        q, k, v, causal=True, key_lengths=lens, sm_scale=scale,
+        softcap=CAP, window=W, block_q=32, block_k=32, interpret=True,
+    )
+    # Compare only query rows with >=1 valid key (row <= len+W-2): rows whose
+    # window misses the valid key range entirely have no defined output (the
+    # kernel zeroes them; the XLA oracle spreads a uniform softmax).
+    for b in range(B):
+        r_valid = min(S, int(lens[b]) + W - 1)
+        np.testing.assert_allclose(
+            np.asarray(out)[b, :, :r_valid],
+            np.asarray(oracle())[b, :, :r_valid],
+            rtol=2e-5,
+            atol=2e-5,
+        )
+
+
+def test_flash_dynamic_window_traced():
+    """The window can be a TRACED scalar (alternating-layer configs pick W per
+    scanned layer) without recompiling per value."""
+    from k_llms_tpu.ops.attention import NO_WINDOW, flash_attention
+
+    B, QH, KVH, S, D = 1, 2, 2, 32, 8
+    key = jax.random.key(5)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, QH, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, KVH, S, D), jnp.float32)
+    v = jax.random.normal(kv_, (B, KVH, S, D), jnp.float32)
+
+    @jax.jit
+    def run(w):
+        return flash_attention(
+            q, k, v, causal=True, window=w, block_q=16, block_k=16, interpret=True
+        )
+
+    windowed = run(jnp.int32(4))
+    full = run(jnp.int32(NO_WINDOW))
+    ref_full = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(ref_full), rtol=2e-5, atol=2e-5)
+    assert not np.allclose(np.asarray(windowed), np.asarray(full))
