@@ -30,6 +30,7 @@ class ChatRequest:
     seed: Optional[int] = None
     response_format: Optional[Any] = None
     logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -126,6 +126,8 @@ class TpuBackend(Backend):
         # Byte tokenizers run the automata directly; BPE vocabularies get
         # token-level masks compiled over the vocabulary (token_constraint.py).
         constraint = self._constraint_for(request.response_format)
+        # OpenAI semantics: top_logprobs only applies when logprobs is on.
+        top_lp = request.top_logprobs if request.logprobs else None
         result = self._generate_batched(
             prompt_ids,
             n=n,
@@ -134,6 +136,7 @@ class TpuBackend(Backend):
             top_p=request.top_p,
             seed=request.seed,
             constraint=constraint,
+            top_logprobs=top_lp,
         )
 
         stop_strings: List[str] = []
@@ -158,15 +161,35 @@ class TpuBackend(Backend):
                     break
             logprobs_payload = None
             if request.logprobs:
+                def _top_entries(step: int):
+                    if result.top_tokens is None:
+                        return []
+                    entries = []
+                    for tid, tlp in zip(
+                        result.top_tokens[i][step].tolist(),
+                        result.top_logprobs[i][step].tolist(),
+                    ):
+                        text_t = tok.decode([int(tid)])
+                        entries.append(
+                            {
+                                "token": text_t,
+                                "logprob": float(tlp),
+                                "bytes": list(text_t.encode("utf-8")),
+                            }
+                        )
+                    return entries
+
                 logprobs_payload = {
                     "content": [
                         {
                             "token": tok.decode([t]),
                             "logprob": float(lp),
                             "bytes": [b for b in tok.decode([t]).encode("utf-8")],
-                            "top_logprobs": [],
+                            "top_logprobs": _top_entries(j),
                         }
-                        for t, lp in zip(ids, result.logprobs[i][:length].tolist())
+                        for j, (t, lp) in enumerate(
+                            zip(ids, result.logprobs[i][:length].tolist())
+                        )
                     ]
                 }
             choices.append(
@@ -209,6 +232,7 @@ class TpuBackend(Backend):
         top_p: Optional[float],
         seed: Optional[int],
         constraint: Any,
+        top_logprobs: Optional[int] = None,
     ):
         """Submit one generation through the coalescing scheduler: concurrent
         requests with the same sampling config decode as ONE batched XLA
@@ -223,7 +247,7 @@ class TpuBackend(Backend):
                 else (type(constraint).__name__, constraint.digest)
             )
         eos_ids = self.tokenizer.stop_ids
-        batch_key = (max_new, temperature, top_p, ckey, tuple(eos_ids))
+        batch_key = (max_new, temperature, top_p, ckey, tuple(eos_ids), top_logprobs)
 
         def run(specs):
             return self.engine.generate_many(
@@ -233,6 +257,7 @@ class TpuBackend(Backend):
                 top_p=top_p,
                 eos_ids=eos_ids,
                 constraint=constraint,
+                top_logprobs=top_logprobs,
             )
 
         # Weight = this request's padded row count (the engine rounds n up to a

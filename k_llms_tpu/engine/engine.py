@@ -31,8 +31,15 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..models.config import ModelConfig, get_config
-from ..models.llama import KVCache, decode_step, forward, init_cache, init_params, prefill
-from ..ops.sampling import sample_logits
+from ..models.llama import (
+    KVCache,
+    decode_step,
+    encode,
+    init_cache,
+    init_params,
+    prefill,
+)
+from ..ops.sampling import model_top_logprobs, sample_logits
 from ..parallel.mesh import DATA_AXIS, auto_mesh
 from ..parallel.sharding import batch_spec, cache_specs, param_specs
 
@@ -47,6 +54,10 @@ class GenerationResult(NamedTuple):
     lengths: np.ndarray  # [n] generated token counts (including the stop token)
     finish_reasons: List[str]  # "stop" | "length" per sample
     prompt_len: int
+    # Only when requested via top_logprobs=k: per-step top-k alternatives
+    # under the untempered model distribution (OpenAI `top_logprobs`).
+    top_tokens: Optional[np.ndarray] = None  # [n, max_new, k] int32
+    top_logprobs: Optional[np.ndarray] = None  # [n, max_new, k] f32
 
 
 class GenRequestSpec(NamedTuple):
@@ -169,6 +180,7 @@ class LocalEngine:
         top_p: Optional[float],
         top_k: Optional[int],
         constraint: Optional[str] = None,
+        top_logprobs: Optional[int] = None,
     ):
         """Jitted decode loop for R requests × n_per samples each (R=1 is the
         single-request case; R>1 is the cross-request coalesced batch).
@@ -189,6 +201,7 @@ class LocalEngine:
             constraint_key = ("schema", constraint.digest)
         cache_key = (
             num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
+            top_logprobs,
         )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
@@ -265,12 +278,24 @@ class LocalEngine:
             tokens_buf = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
             logprob_buf = jnp.zeros((B, max_new), jnp.float32).at[:, 0].set(lp0)
 
+            # Optional top-k alternatives per step (OpenAI `top_logprobs`),
+            # captured from the same post-constraint-mask logits that sampling
+            # sees. Zero-size dummies thread through the loop when off.
+            K = top_logprobs or 0
+            if K:
+                t_ids0, t_lps0 = model_top_logprobs(logits0, K)
+                tt_buf = jnp.zeros((B, max_new, K), jnp.int32).at[:, 0].set(t_ids0)
+                tl_buf = jnp.zeros((B, max_new, K), jnp.float32).at[:, 0].set(t_lps0)
+            else:
+                tt_buf = jnp.zeros((B, 0, 0), jnp.int32)
+                tl_buf = jnp.zeros((B, 0, 0), jnp.float32)
+
             def cond(state):
                 step, cur, done, *_ = state
                 return jnp.logical_and(step < max_new - 1, jnp.logical_not(jnp.all(done)))
 
             def body(state):
-                step, cur, done, cache, toks, lps, jst = state
+                step, cur, done, cache, toks, lps, tt, tl, jst = state
                 logits, cache = decode_step(
                     config, params, cur, step, prompt_lens, cache, prefix
                 )
@@ -284,12 +309,21 @@ class LocalEngine:
                 lp = jnp.where(done, 0.0, lp)
                 toks = lax.dynamic_update_slice(toks, nxt[:, None], (0, step + 1))
                 lps = lax.dynamic_update_slice(lps, lp[:, None], (0, step + 1))
+                if K:
+                    t_ids, t_lps = model_top_logprobs(logits, K)
+                    tt = lax.dynamic_update_slice(tt, t_ids[:, None, :], (0, step + 1, 0))
+                    tl = lax.dynamic_update_slice(tl, t_lps[:, None, :], (0, step + 1, 0))
                 done = jnp.logical_or(done, jnp.isin(nxt, eos_ids))
-                return (step + 1, nxt, done, cache, toks, lps, jst)
+                return (step + 1, nxt, done, cache, toks, lps, tt, tl, jst)
 
-            state = (jnp.int32(0), tok0, done0, gen_cache, tokens_buf, logprob_buf, jstate)
-            step, cur, done, cache, toks, lps, _ = lax.while_loop(cond, body, state)
-            return toks, lps, done
+            state = (
+                jnp.int32(0), tok0, done0, gen_cache, tokens_buf, logprob_buf,
+                tt_buf, tl_buf, jstate,
+            )
+            step, cur, done, cache, toks, lps, tt, tl, _ = lax.while_loop(
+                cond, body, state
+            )
+            return toks, lps, done, tt, tl
 
         fn = jax.jit(_loop)
         self._decode_cache[cache_key] = fn
@@ -368,6 +402,7 @@ class LocalEngine:
         seed: Optional[int] = None,
         eos_ids: Optional[Sequence[int]] = None,
         constraint: Optional[str] = None,
+        top_logprobs: Optional[int] = None,
     ) -> GenerationResult:
         config = self.config
         prompt_ids, prompt_len, bucket = self._prep_prompt(prompt_ids)
@@ -392,9 +427,10 @@ class LocalEngine:
             self.params, tokens, jnp.int32(prompt_len)
         )
         loop = self._get_decode_loop(
-            1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint
+            1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint,
+            top_logprobs,
         )
-        toks, lps, done = loop(
+        toks, lps, done, tt, tl = loop(
             self.params,
             prefix,
             jnp.array([prompt_len], jnp.int32),
@@ -405,8 +441,8 @@ class LocalEngine:
 
         # ONE host transfer for all outputs: on relayed/remote device platforms
         # every device_get pays a full round trip (~74 ms through the axon
-        # relay), so fetching the three buffers separately would triple it.
-        toks_np, lps_np, done_np = jax.device_get((toks, lps, done))
+        # relay), so fetching the buffers separately would multiply it.
+        toks_np, lps_np, done_np, tt_np, tl_np = jax.device_get((toks, lps, done, tt, tl))
         toks_np = np.asarray(toks_np)[:n]
         lps_np = np.asarray(lps_np)[:n]
         done_np = np.asarray(done_np)[:n]
@@ -421,6 +457,8 @@ class LocalEngine:
             lengths=lengths,
             finish_reasons=finish,
             prompt_len=prompt_len,
+            top_tokens=np.asarray(tt_np)[:n] if top_logprobs else None,
+            top_logprobs=np.asarray(tl_np)[:n] if top_logprobs else None,
         )
 
     def generate_many(
@@ -433,6 +471,7 @@ class LocalEngine:
         top_k: Optional[int] = None,
         eos_ids: Optional[Sequence[int]] = None,
         constraint: Optional[str] = None,
+        top_logprobs: Optional[int] = None,
     ) -> List[GenerationResult]:
         """Decode several same-config requests as ONE batched XLA program.
 
@@ -460,6 +499,7 @@ class LocalEngine:
                     seed=it.seed,
                     eos_ids=eos_ids,
                     constraint=constraint,
+                    top_logprobs=top_logprobs,
                 )
             ]
 
@@ -520,13 +560,18 @@ class LocalEngine:
         req_keys = jnp.stack([jax.random.key(s) for s in seeds])
 
         loop = self._get_decode_loop(
-            r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint
+            r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint,
+            top_logprobs,
         )
-        toks, lps, done = loop(
+        toks, lps, done, tt, tl = loop(
             self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr
         )
-        toks_np, lps_np, done_np = jax.device_get((toks, lps, done))
-        toks_np, lps_np, done_np = map(np.asarray, (toks_np, lps_np, done_np))
+        toks_np, lps_np, done_np, tt_np, tl_np = jax.device_get(
+            (toks, lps, done, tt, tl)
+        )
+        toks_np, lps_np, done_np, tt_np, tl_np = map(
+            np.asarray, (toks_np, lps_np, done_np, tt_np, tl_np)
+        )
 
         results: List[GenerationResult] = []
         for j, (it, (_, prompt_len, _)) in enumerate(zip(items, preps)):
@@ -542,6 +587,8 @@ class LocalEngine:
                     lengths=lengths,
                     finish_reasons=["stop" if x else "length" for x in d],
                     prompt_len=prompt_len,
+                    top_tokens=tt_np[lo : lo + n_j] if top_logprobs else None,
+                    top_logprobs=tl_np[lo : lo + n_j] if top_logprobs else None,
                 )
             )
         return results
@@ -554,7 +601,7 @@ class LocalEngine:
             config = self.config
 
             def _embed(params, tokens, mask):
-                _, hidden = forward(config, params, tokens, mask)
+                hidden = encode(config, params, tokens, mask)
                 m = mask[:, :, None].astype(jnp.float32)
                 pooled = (hidden.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
                 return pooled

@@ -472,6 +472,19 @@ def _logits(config: ModelConfig, params: Params, h: jax.Array) -> jax.Array:
     return logits
 
 
+def encode(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,
+    pad_mask: jax.Array,
+) -> jax.Array:
+    """Final hidden states [B,S,H] — the on-device embedding provider only
+    mean-pools hidden states. Under ``jax.jit`` the unused logits output (the
+    lm_head projection, the single largest matmul in the network) is pruned by
+    XLA dead-code elimination, so this thin wrapper costs nothing."""
+    return forward(config, params, tokens, pad_mask)[1]
+
+
 def forward(
     config: ModelConfig,
     params: Params,
@@ -479,8 +492,7 @@ def forward(
     pad_mask: jax.Array,
 ) -> Tuple[jax.Array, jax.Array]:
     """Full-sequence causal forward (no cache). Returns (logits f32 [B,S,V],
-    final hidden states [B,S,H]) — hidden states feed the on-device embedding
-    provider (mean-pooled) used by the consensus similarity scorer."""
+    final hidden states [B,S,H])."""
     B, S = tokens.shape
     positions = jnp.cumsum(pad_mask.astype(jnp.int32), axis=1) - 1
     positions = jnp.maximum(positions, 0)

@@ -53,14 +53,45 @@ def sample_logits(
             sampling_logits = jnp.where(sampling_logits < kth, -jnp.inf, sampling_logits)
 
         if top_p is not None and top_p < 1.0:
-            sorted_logits = jnp.sort(sampling_logits, axis=-1)[:, ::-1]
-            sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cumulative = jnp.cumsum(sorted_probs, axis=-1)
-            # Keep the smallest prefix with cumulative mass >= top_p (the token
-            # that crosses the boundary stays in).
-            keep_sorted = (cumulative - sorted_probs) < top_p
+            # Keep the smallest set with cumulative mass >= top_p (boundary
+            # token stays in; equal-logit ties stay in). Implemented as a
+            # bisection on the logit threshold instead of a full-vocab sort:
+            # mass({logit > t}) is monotone in t, and the loop runs until
+            # every row's bracket has collapsed to ADJACENT floats (midpoint
+            # rounds onto an endpoint — a stalled row no longer changes), at
+            # which point no representable logit lies strictly inside it and
+            # the kept set {logit > lo} is EXACTLY the sort-based set — at a
+            # fraction of the cost (XLA's 128k-wide sort is ~5.5 ms/step for
+            # n=32 on v5e; this is typically ~30 masked reductions).
+            probs = jax.nn.softmax(sampling_logits, axis=-1)
+            finite = jnp.isfinite(sampling_logits)
+            lo = (
+                jnp.min(jnp.where(finite, sampling_logits, jnp.inf), axis=-1) - 1.0
+            )  # below every value: mass({> lo}) = 1 >= top_p
+            hi = jnp.max(
+                jnp.where(finite, sampling_logits, -jnp.inf), axis=-1
+            )  # the max value: mass({> hi}) = 0 < top_p
+
+            def _progress(lohi):
+                lo, hi = lohi
+                mid = 0.5 * (lo + hi)
+                return jnp.any((mid > lo) & (mid < hi))
+
+            def _bisect(lohi):
+                lo, hi = lohi
+                mid = 0.5 * (lo + hi)
+                mass = jnp.sum(
+                    jnp.where(sampling_logits > mid[:, None], probs, 0.0), axis=-1
+                )
+                go_hi = mass < top_p
+                return jnp.where(go_hi, lo, mid), jnp.where(go_hi, mid, hi)
+
+            lo, hi = jax.lax.while_loop(_progress, _bisect, (lo, hi))
+            # The boundary token's logit: smallest present value above lo.
             threshold = jnp.min(
-                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+                jnp.where(sampling_logits > lo[:, None], sampling_logits, jnp.inf),
+                axis=-1,
+                keepdims=True,
             )
             sampling_logits = jnp.where(sampling_logits < threshold, -jnp.inf, sampling_logits)
 
@@ -73,3 +104,21 @@ def sample_logits(
 
     logprobs = jnp.take_along_axis(model_logprobs, tokens[:, None], axis=-1)[:, 0]
     return tokens, logprobs
+
+
+def model_top_logprobs(
+    logits: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k alternatives under the UNtempered model distribution (what
+    OpenAI's ``top_logprobs`` reports), with the same non-finite-row
+    sanitization as :func:`sample_logits`. logits: [B, V] f32.
+
+    Returns (token ids [B, k] int32, logprobs [B, k] f32, sorted desc).
+    """
+    finite = jnp.isfinite(logits)
+    row_ok = jnp.any(finite, axis=-1, keepdims=True)
+    logits = jnp.where(finite, logits, -jnp.inf)
+    logits = jnp.where(row_ok, logits, 0.0)
+    lps = jax.nn.log_softmax(logits, axis=-1)
+    top_lps, top_ids = jax.lax.top_k(lps, k)
+    return top_ids.astype(jnp.int32), top_lps

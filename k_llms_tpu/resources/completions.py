@@ -61,8 +61,15 @@ def _build_request(
     kwargs = dict(kwargs)
     kwargs.pop("stream", None)  # streaming unsupported, like the reference (:36)
     logprobs = kwargs.pop("logprobs", None)
+    top_logprobs = kwargs.pop("top_logprobs", None)
+    if top_logprobs is not None and not 0 <= int(top_logprobs) <= 20:
+        # OpenAI's documented range; also bounds the per-k compile count of
+        # the jitted decode loop, and fails here as a parameter error instead
+        # of an opaque trace error inside top_k.
+        raise ValueError(f"top_logprobs must be in 0..20, got {top_logprobs}")
     return ChatRequest(
         logprobs=logprobs,
+        top_logprobs=top_logprobs,
         messages=messages,
         model=model,
         n=n or 1,

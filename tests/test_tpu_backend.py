@@ -162,3 +162,46 @@ def test_concurrent_requests_coalesce(client):
         assert [c.message.content for c in r.choices] == [
             c.message.content for c in s.choices
         ]
+
+
+def test_top_logprobs_surface(client):
+    """OpenAI parity: logprobs=True + top_logprobs=k returns k ranked
+    alternatives per emitted token, containing real model logprobs."""
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "tlp"}],
+        model="tiny",
+        n=2,
+        seed=4,
+        logprobs=True,
+        top_logprobs=3,
+    )
+    sample = resp.choices[1]
+    assert sample.logprobs is not None
+    for entry in sample.logprobs.content:
+        tops = entry.top_logprobs
+        assert len(tops) == 3
+        lps = [t.logprob for t in tops]
+        assert lps == sorted(lps, reverse=True)  # ranked desc
+        assert all(lp <= 0.0 for lp in lps)
+        # The best alternative is at least as likely as the emitted token.
+        assert lps[0] >= entry.logprob - 1e-5
+
+
+def test_top_logprobs_requires_logprobs(client):
+    # OpenAI semantics: top_logprobs without logprobs=True is ignored.
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "x"}],
+        model="tiny",
+        n=2,
+        seed=4,
+        top_logprobs=3,
+    )
+    assert resp.choices[1].logprobs is None
+
+
+def test_top_logprobs_range_validated(client):
+    with pytest.raises(ValueError, match="top_logprobs"):
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "x"}], model="tiny", n=1,
+            logprobs=True, top_logprobs=21,
+        )
