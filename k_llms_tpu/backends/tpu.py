@@ -137,6 +137,8 @@ class TpuBackend(Backend):
             seed=request.seed,
             constraint=constraint,
             top_logprobs=top_lp,
+            frequency_penalty=float(request.frequency_penalty or 0.0),
+            presence_penalty=float(request.presence_penalty or 0.0),
         )
 
         stop_strings: List[str] = []
@@ -233,6 +235,8 @@ class TpuBackend(Backend):
         seed: Optional[int],
         constraint: Any,
         top_logprobs: Optional[int] = None,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
     ):
         """Submit one generation through the coalescing scheduler: concurrent
         requests with the same sampling config decode as ONE batched XLA
@@ -247,7 +251,10 @@ class TpuBackend(Backend):
                 else (type(constraint).__name__, constraint.digest)
             )
         eos_ids = self.tokenizer.stop_ids
-        batch_key = (max_new, temperature, top_p, ckey, tuple(eos_ids), top_logprobs)
+        batch_key = (
+            max_new, temperature, top_p, ckey, tuple(eos_ids), top_logprobs,
+            frequency_penalty, presence_penalty,
+        )
 
         def run(specs):
             return self.engine.generate_many(
@@ -258,6 +265,8 @@ class TpuBackend(Backend):
                 eos_ids=eos_ids,
                 constraint=constraint,
                 top_logprobs=top_logprobs,
+                frequency_penalty=frequency_penalty,
+                presence_penalty=presence_penalty,
             )
 
         # Weight = this request's padded row count (the engine rounds n up to a

@@ -181,6 +181,8 @@ class LocalEngine:
         top_k: Optional[int],
         constraint: Optional[str] = None,
         top_logprobs: Optional[int] = None,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
     ):
         """Jitted decode loop for R requests × n_per samples each (R=1 is the
         single-request case; R>1 is the cross-request coalesced batch).
@@ -201,7 +203,7 @@ class LocalEngine:
             constraint_key = ("schema", constraint.digest)
         cache_key = (
             num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
-            top_logprobs,
+            top_logprobs, frequency_penalty, presence_penalty,
         )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
@@ -264,11 +266,23 @@ class LocalEngine:
 
             jstate = initial_state(B) if constraint is not None else None
 
+            # pad_id must never be SAMPLED on a live row (lengths count
+            # non-pad tokens; an interior pad would punch a hole in the
+            # sequence). Masked dynamically because HF tokenizers may map
+            # pad onto eos — then it must stay sampleable as the stop token.
+            pad_col = jnp.where(
+                jnp.isin(jnp.int32(pad_id), eos_ids), 0.0, -jnp.inf
+            )
+
+            def _mask_pad(logits):
+                return logits.at[:, pad_id].add(pad_col)
+
             # First token: each request's prefill logits, n_per draws apiece.
             V = first_logits.shape[-1]
             logits0 = jnp.broadcast_to(first_logits[:, None, :], (R, n_per, V)).reshape(B, V)
             if jstate is not None:
                 logits0 = mask_logits(jt, logits0, *jstate, eos_ids)
+            logits0 = _mask_pad(logits0)
             tok0, lp0 = sample(logits0, None, row_keys=_row_keys(req_keys, jnp.int32(0)))
             tok0 = self._constraint(tok0, batch_spec())
             if jstate is not None:
@@ -290,18 +304,41 @@ class LocalEngine:
                 tt_buf = jnp.zeros((B, 0, 0), jnp.int32)
                 tl_buf = jnp.zeros((B, 0, 0), jnp.float32)
 
+            # Frequency/presence penalties over GENERATED tokens (vLLM
+            # semantics): per-row counts live in the loop state; the penalty
+            # array shapes the sampling distribution each step. Zero-size
+            # dummy when both are off.
+            penalized = frequency_penalty != 0.0 or presence_penalty != 0.0
+            V_counts = config.vocab_size if penalized else 0
+            counts0 = jnp.zeros((B, V_counts), jnp.float32)
+            if penalized:
+                counts0 = counts0.at[jnp.arange(B), tok0].add(1.0)
+
+            def _penalty(counts):
+                if not penalized:
+                    return None
+                return frequency_penalty * counts + presence_penalty * (
+                    counts > 0
+                ).astype(jnp.float32)
+
             def cond(state):
                 step, cur, done, *_ = state
                 return jnp.logical_and(step < max_new - 1, jnp.logical_not(jnp.all(done)))
 
             def body(state):
-                step, cur, done, cache, toks, lps, tt, tl, jst = state
+                step, cur, done, cache, toks, lps, tt, tl, counts, jst = state
                 logits, cache = decode_step(
                     config, params, cur, step, prompt_lens, cache, prefix
                 )
                 if jst is not None:
                     logits = mask_logits(jt, logits, *jst, eos_ids)
-                nxt, lp = sample(logits, None, row_keys=_row_keys(req_keys, step + 1))
+                logits = _mask_pad(logits)
+                nxt, lp = sample(
+                    logits,
+                    None,
+                    row_keys=_row_keys(req_keys, step + 1),
+                    penalty=_penalty(counts),
+                )
                 nxt = jnp.where(done, pad_id, nxt).astype(jnp.int32)
                 nxt = self._constraint(nxt, batch_spec())
                 if jst is not None:
@@ -313,14 +350,19 @@ class LocalEngine:
                     t_ids, t_lps = model_top_logprobs(logits, K)
                     tt = lax.dynamic_update_slice(tt, t_ids[:, None, :], (0, step + 1, 0))
                     tl = lax.dynamic_update_slice(tl, t_lps[:, None, :], (0, step + 1, 0))
+                if penalized:
+                    # Finished rows emit pad_id; don't count it.
+                    counts = counts.at[jnp.arange(B), nxt].add(
+                        jnp.where(done, 0.0, 1.0)
+                    )
                 done = jnp.logical_or(done, jnp.isin(nxt, eos_ids))
-                return (step + 1, nxt, done, cache, toks, lps, tt, tl, jst)
+                return (step + 1, nxt, done, cache, toks, lps, tt, tl, counts, jst)
 
             state = (
                 jnp.int32(0), tok0, done0, gen_cache, tokens_buf, logprob_buf,
-                tt_buf, tl_buf, jstate,
+                tt_buf, tl_buf, counts0, jstate,
             )
-            step, cur, done, cache, toks, lps, tt, tl, _ = lax.while_loop(
+            step, cur, done, cache, toks, lps, tt, tl, _, _ = lax.while_loop(
                 cond, body, state
             )
             return toks, lps, done, tt, tl
@@ -403,6 +445,8 @@ class LocalEngine:
         eos_ids: Optional[Sequence[int]] = None,
         constraint: Optional[str] = None,
         top_logprobs: Optional[int] = None,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
     ) -> GenerationResult:
         config = self.config
         prompt_ids, prompt_len, bucket = self._prep_prompt(prompt_ids)
@@ -428,7 +472,7 @@ class LocalEngine:
         )
         loop = self._get_decode_loop(
             1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint,
-            top_logprobs,
+            top_logprobs, frequency_penalty, presence_penalty,
         )
         toks, lps, done, tt, tl = loop(
             self.params,
@@ -472,6 +516,8 @@ class LocalEngine:
         eos_ids: Optional[Sequence[int]] = None,
         constraint: Optional[str] = None,
         top_logprobs: Optional[int] = None,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
     ) -> List[GenerationResult]:
         """Decode several same-config requests as ONE batched XLA program.
 
@@ -500,6 +546,8 @@ class LocalEngine:
                     eos_ids=eos_ids,
                     constraint=constraint,
                     top_logprobs=top_logprobs,
+                    frequency_penalty=frequency_penalty,
+                    presence_penalty=presence_penalty,
                 )
             ]
 
@@ -561,7 +609,7 @@ class LocalEngine:
 
         loop = self._get_decode_loop(
             r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint,
-            top_logprobs,
+            top_logprobs, frequency_penalty, presence_penalty,
         )
         toks, lps, done, tt, tl = loop(
             self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr

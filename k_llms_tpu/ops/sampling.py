@@ -24,11 +24,16 @@ def sample_logits(
     top_p: Optional[float] = None,
     top_k: Optional[int] = None,
     row_keys: Optional[jax.Array] = None,
+    penalty: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Sample next tokens. logits: [B, V] f32; key: one PRNG key, folded per row.
     ``row_keys`` ([B] typed keys) overrides the internal per-row fold — the
     coalesced multi-request decode path derives each row's key from its OWN
     request seed so per-request draws don't depend on batch composition.
+    ``penalty`` ([B, V] f32) is subtracted from the logits BEFORE temperature
+    (OpenAI's frequency/presence formula: mu[j] - c[j]*a_freq - 1{c}*a_pres);
+    it shapes the sampling distribution only — reported logprobs stay the
+    unpenalized model distribution's.
 
     Returns (tokens [B] int32, logprobs [B] f32 — log p(token) under the
     untempered model distribution).
@@ -42,6 +47,8 @@ def sample_logits(
     logits = jnp.where(finite, logits, -jnp.inf)
     logits = jnp.where(row_ok, logits, 0.0)
     model_logprobs = jax.nn.log_softmax(logits, axis=-1)
+    if penalty is not None:
+        logits = logits - penalty
 
     if temperature == 0.0:
         tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)

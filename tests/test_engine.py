@@ -265,3 +265,32 @@ def test_top_p_bisection_matches_sort_reference():
                 assert set(np.asarray(tokens)[:, row].tolist()) <= set(
                     np.flatnonzero(kept[row]).tolist()
                 )
+
+
+def test_frequency_penalty_blocks_repeats(engine, tok):
+    """An extreme frequency penalty makes greedy decode never repeat a token
+    within a sample (the defining property of the OpenAI formula)."""
+    ids = tok.encode("aaa")
+    r = engine.generate(
+        ids, n=2, max_new_tokens=10, temperature=0.0, frequency_penalty=1000.0
+    )
+    for i in range(2):
+        emitted = r.tokens[i][: int(r.lengths[i])].tolist()
+        assert len(emitted) == len(set(emitted))  # no repeats
+
+    # Without the penalty, greedy output differs (and is allowed to repeat).
+    r0 = engine.generate(ids, n=2, max_new_tokens=10, temperature=0.0)
+    assert not (r0.tokens == r.tokens).all()
+
+
+def test_presence_penalty_blocks_repeats(engine, tok):
+    ids = tok.encode("xyz")
+    b = engine.generate(
+        ids, n=2, max_new_tokens=8, temperature=0.0, presence_penalty=1000.0
+    )
+    for i in range(2):
+        emitted = b.tokens[i][: int(b.lengths[i])].tolist()
+        assert len(emitted) == len(set(emitted))
+    # Reported logprobs stay the MODEL distribution's (penalty shapes sampling
+    # only): every reported logprob is a valid log-probability.
+    assert (b.logprobs[b.tokens != engine.config.pad_token_id] <= 0).all()
