@@ -77,6 +77,19 @@ class HFTokenizer:
     def apply_chat_template(
         self, messages: List[Dict[str, str]], add_generation_prompt: bool = True
     ) -> List[int]:
+        if getattr(self._tok, "chat_template", None) is None:
+            # Checkpoint dirs without a chat template (base models) get a
+            # minimal llama-style layout instead of a hard error — same
+            # structure as ByteTokenizer.apply_chat_template: every turn ends
+            # with the stop token so multi-turn boundaries are marked.
+            eot = self.stop_ids[-1]
+            ids: List[int] = [self.bos_id] if self.bos_id is not None else []
+            for m in messages:
+                ids += self.encode(f"<{m.get('role', 'user')}>\n{m.get('content', '')}")
+                ids.append(eot)
+            if add_generation_prompt:
+                ids += self.encode("<assistant>\n")
+            return ids
         return self._tok.apply_chat_template(
             messages, add_generation_prompt=add_generation_prompt, tokenize=True
         )

@@ -35,7 +35,24 @@ def load_orbax(path: str) -> Dict[str, Any]:
     import orbax.checkpoint as ocp
 
     checkpointer = ocp.StandardCheckpointer()
-    return checkpointer.restore(os.path.abspath(path))
+    restored = checkpointer.restore(os.path.abspath(path))
+    return _rebuild_qtensors(restored)
+
+
+def _rebuild_qtensors(tree: Any) -> Any:
+    """Orbax restores NamedTuples as plain dicts when no target structure is
+    given; rebuild QTensor leaves (exactly {"q", "scale"} with an int8
+    payload) so int8 checkpoints round-trip into the quantization-aware
+    matmuls instead of crashing qdot."""
+    from .quant import QTensor
+
+    if isinstance(tree, dict):
+        if set(tree.keys()) == {"q", "scale"} and getattr(
+            tree["q"], "dtype", None
+        ) == jnp.int8:
+            return QTensor(q=tree["q"], scale=tree["scale"])
+        return {k: _rebuild_qtensors(v) for k, v in tree.items()}
+    return tree
 
 
 def _hf_key(layer: int, name: str) -> str:

@@ -213,3 +213,28 @@ def test_parse_bpe_constraint_real_checkpoint(hf_dir):
         if c.finish_reason == "stop":  # completed samples must validate
             obj = json.loads(c.message.content)
             Item.model_validate(obj)
+
+
+def test_hf_tokenizer_without_chat_template(tmp_path, hf_dir):
+    """Base-model checkpoints ship no chat template; the tokenizer falls back
+    to a minimal llama-style layout instead of raising."""
+    import shutil
+
+    d = tmp_path / "no_template"
+    shutil.copytree(hf_dir, d)
+    # strip the template from the saved tokenizer config
+    cfg_path = d / "tokenizer_config.json"
+    cfg = json.loads(cfg_path.read_text())
+    cfg.pop("chat_template", None)
+    cfg_path.write_text(json.dumps(cfg))
+    for extra in ("chat_template.jinja",):  # newer transformers sidecar file
+        p = d / extra
+        if p.exists():
+            p.unlink()
+
+    tok = get_tokenizer(str(d))
+    assert getattr(tok._tok, "chat_template", None) is None
+    ids = tok.apply_chat_template([{"role": "user", "content": "hello"}])
+    assert ids[0] == tok.bos_id
+    text = tok.decode(ids)
+    assert "hello" in text and "<assistant>" in text
