@@ -47,8 +47,11 @@ class BackendConfig(BaseModel):
     max_seq_len: Optional[int] = None
     attention_impl: Optional[str] = None  # prefill: "xla" | "flash"
     decode_attention_impl: Optional[str] = None  # decode: "xla" | "flash"
-    # Weight quantization: None (model dtype) or "int8" (per-channel symmetric;
-    # halves decode HBM traffic, fits 8B-class weights on one v5e chip).
+    # Weight quantization: None (model dtype), "int8" (per-channel symmetric;
+    # halves decode HBM traffic — the LATENCY config, ~75% of peak bandwidth
+    # on v5e), or "int4" (group-wise symmetric via the Pallas w4a16 kernel —
+    # the CAPACITY config: ~40% smaller footprint for larger KV/models per
+    # chip, ~25% slower decode; falls back to int8 on a mesh).
     quantization: Optional[str] = None
 
 
@@ -86,9 +89,11 @@ class TpuBackend(Backend):
         if overrides:
             model_config = model_config.with_(**overrides)
         self.tokenizer = get_tokenizer(cfg.tokenizer_path)
-        if cfg.quantization not in (None, "int8"):
+        if cfg.quantization not in (None, "int8", "int4"):
             # Validate before the (potentially multi-GB) checkpoint load.
-            raise ValueError(f"Unsupported quantization {cfg.quantization!r}; use 'int8'")
+            raise ValueError(
+                f"Unsupported quantization {cfg.quantization!r}; use 'int8' or 'int4'"
+            )
         params = None
         if cfg.checkpoint_path:
             from ..models.loader import load_checkpoint
@@ -100,7 +105,7 @@ class TpuBackend(Backend):
             mesh=mesh,
             model_parallel=cfg.model_parallel,
             param_seed=cfg.param_seed,
-            quantize=cfg.quantization == "int8",
+            quantize=cfg.quantization or False,
         )
         self.default_max_new_tokens = cfg.max_new_tokens
         # All device work funnels through one scheduler so concurrent clients

@@ -86,13 +86,23 @@ class LocalEngine:
         model_parallel: Optional[int] = None,
         param_seed: int = 0,
         use_mesh: bool = True,
-        quantize: bool = False,
+        quantize: "bool | str" = False,
     ):
         self.config = get_config(config) if isinstance(config, str) else config
         if mesh is None and use_mesh and len(jax.devices()) > 1:
             mesh = auto_mesh(model_parallel=model_parallel)
         self.mesh = mesh
+        if quantize is True:
+            quantize = "int8"
+        if quantize == "int4" and mesh is not None:
+            # The w4a16 Pallas kernel is a single-chip serving optimization;
+            # under GSPMD the weights are sharded and the kernel would need a
+            # shard_map wrapper. int8 (XLA-native, partitionable) is the
+            # multi-chip quantized path.
+            logger.warning("int4 quantization is single-chip only; using int8 on mesh")
+            quantize = "int8"
         self.quantized = quantize
+        bits = 4 if quantize == "int4" else 8
 
         pspecs = param_specs(self.config)
         if quantize:
@@ -102,11 +112,11 @@ class LocalEngine:
 
         if params is None:
             if quantize:
-                # Build the int8 tree directly — an 8B bf16 tree (~16 GB)
+                # Build the int8/int4 tree directly — an 8B bf16 tree (~16 GB)
                 # cannot coexist with its quantized copy in one chip's HBM.
                 from ..models.quant import init_params_quantized
 
-                init = partial(init_params_quantized, self.config)
+                init = partial(init_params_quantized, self.config, bits=bits)
             else:
                 init = partial(init_params, self.config)
             if self.mesh is not None:
@@ -122,7 +132,7 @@ class LocalEngine:
                 # Quantize on device (jitted) so the bf16 tree never has to fit
                 # alongside a second full copy in HBM per-shard.
                 qz = jax.jit(
-                    quantize_params,
+                    partial(quantize_params, bits=bits),
                     out_shardings=self._shard_tree(qspecs) if self.mesh is not None else None,
                 )
                 params = qz(params)

@@ -41,15 +41,20 @@ def load_orbax(path: str) -> Dict[str, Any]:
 
 def _rebuild_qtensors(tree: Any) -> Any:
     """Orbax restores NamedTuples as plain dicts when no target structure is
-    given; rebuild QTensor leaves (exactly {"q", "scale"} with an int8
-    payload) so int8 checkpoints round-trip into the quantization-aware
-    matmuls instead of crashing qdot."""
-    from .quant import QTensor
+    given; rebuild QTensor/Q4Tensor leaves (exactly {"q", "scale"} with an
+    int8 payload) so quantized checkpoints round-trip into the
+    quantization-aware matmuls instead of crashing qdot. The two layouts are
+    distinguished by the scale shape: int8 keeps a keepdims per-channel scale
+    ([..., 1, N]); int4 carries one scale per 128-row group ([..., K/128, N],
+    K >= 256 so never 1)."""
+    from .quant import Q4Tensor, QTensor
 
     if isinstance(tree, dict):
         if set(tree.keys()) == {"q", "scale"} and getattr(
             tree["q"], "dtype", None
         ) == jnp.int8:
+            if tree["scale"].shape[-2] > 1:
+                return Q4Tensor(q=tree["q"], scale=tree["scale"])
             return QTensor(q=tree["q"], scale=tree["scale"])
         return {k: _rebuild_qtensors(v) for k, v in tree.items()}
     return tree

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..ops.w4matmul import Q4Tensor, pack_int4, supports_int4, unpack_int4, w4_matmul
+
 
 class QTensor(NamedTuple):
     """Symmetric per-output-channel int8 weight: ``q`` has the weight's shape
@@ -41,7 +43,7 @@ class QTensor(NamedTuple):
         return self.q.dtype
 
 
-WeightLike = Union[jax.Array, QTensor]
+WeightLike = Union[jax.Array, QTensor, Q4Tensor]
 
 # Matmul weights to quantize (all contract over axis -2). Embeddings and norms
 # stay in the model dtype.
@@ -58,9 +60,20 @@ def quantize_weight(w: jax.Array) -> QTensor:
 
 
 def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
-    """``x @ w`` for a plain array or a QTensor. For QTensor the int8 payload is
-    cast inside the matmul (HBM reads stay int8) and the per-channel scale is
-    applied to the output."""
+    """``x @ w`` for a plain array, a QTensor, or a Q4Tensor. For QTensor the
+    int8 payload is cast inside the matmul (HBM reads stay int8) and the
+    per-channel scale is applied to the output. For Q4Tensor the Pallas w4a16
+    kernel unpacks nibbles in VMEM (HBM reads stay int4); off-TPU the kernel
+    runs in interpret mode only for realistic shapes — tiny test shapes take
+    the XLA dequant reference inside :func:`w4_matmul`."""
+    if isinstance(w, Q4Tensor):
+        lead = x.shape[:-1]
+        rows = 1
+        for d in lead:
+            rows *= d
+        x2 = x.reshape(rows, x.shape[-1])
+        out = w4_matmul(x2, w, interpret=jax.default_backend() != "tpu")
+        return out.reshape(*lead, w.q.shape[-1])
     if isinstance(w, QTensor):
         out = x @ w.q.astype(x.dtype)
         return out * w.scale[..., 0, :].astype(out.dtype)
@@ -78,18 +91,41 @@ def qeinsum(spec: str, x: jax.Array, w: WeightLike) -> jax.Array:
     return jnp.einsum(spec, x, w)
 
 
-def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
-    """Quantize the seven block matmuls and lm_head; leave embed/norms as-is."""
+def _int4_eligible_shape(ndim: int, k: int, n: int) -> bool:
+    """Q4 needs whole 256-row K blocks and 128-col N blocks; MoE expert stacks
+    ([L, E, K, N], ndim 4) stay int8 — their einsum contraction has no w4
+    kernel. Tiny test models fail the divisibility and stay int8 too. Single
+    predicate for BOTH the quantize path and the random-init path, so the two
+    always build the same QTensor/Q4Tensor tree layout for a given config."""
+    return ndim <= 3 and supports_int4(k) and n % 128 == 0
+
+
+def _int4_eligible(w: jax.Array) -> bool:
+    return _int4_eligible_shape(w.ndim, w.shape[-2], w.shape[-1])
+
+
+def quantize_weight_bits(w: jax.Array, bits: int) -> WeightLike:
+    if bits == 4 and _int4_eligible(w):
+        return pack_int4(w)
+    return quantize_weight(w)
+
+
+def quantize_params(params: Dict[str, Any], bits: int = 8) -> Dict[str, Any]:
+    """Quantize the seven block matmuls and lm_head; leave embed/norms as-is.
+
+    ``bits=4`` packs eligible weights group-wise int4 (:mod:`ops.w4matmul`);
+    ineligible ones (MoE expert stacks, non-divisible shapes) fall back int8.
+    """
     layers = dict(params["layers"])
     for key in _QUANT_LAYER_KEYS:
-        layers[key] = quantize_weight(layers[key])
+        layers[key] = quantize_weight_bits(layers[key], bits)
     out = dict(params)
     out["layers"] = layers
-    out["lm_head"] = quantize_weight(params["lm_head"])
+    out["lm_head"] = quantize_weight_bits(params["lm_head"], bits)
     return out
 
 
-def init_params_quantized(config, key: jax.Array, dtype=None) -> Dict[str, Any]:
+def init_params_quantized(config, key: jax.Array, dtype=None, bits: int = 8) -> Dict[str, Any]:
     """Random int8-quantized init, building the QTensor tree DIRECTLY.
 
     For synthetic flagship benches: an 8B bf16 tree (~16 GB) cannot sit in one
@@ -106,7 +142,15 @@ def init_params_quantized(config, key: jax.Array, dtype=None) -> Dict[str, Any]:
     H, I, V = config.hidden_size, config.intermediate_size, config.vocab_size
     L, Q, KV = config.num_layers, config.q_dim, config.kv_dim
 
-    def qinit(k, shape) -> QTensor:
+    def qinit(k, shape) -> WeightLike:
+        K, N = shape[-2], shape[-1]
+        if bits == 4 and _int4_eligible_shape(len(shape), K, N):
+            # Random packed bytes = two uniform nibbles in [-8, 7] apiece
+            # (std ~4.61); scale so effective weights are ~N(0, 1/fan_in).
+            q = jax.random.randint(k, shape[:-2] + (K // 2, N), -128, 128, jnp.int8)
+            scale_val = 1.0 / (4.61 * math.sqrt(K))
+            scale = jnp.full(shape[:-2] + (K // 128, N), scale_val, jnp.float32)
+            return Q4Tensor(q=q, scale=scale)
         q = jax.random.randint(k, shape, -127, 128, jnp.int8)
         # std(uniform int8) = 127/sqrt(3); scale it to 1/sqrt(fan_in).
         scale_val = math.sqrt(3.0) / (127.0 * math.sqrt(shape[-2]))

@@ -1,0 +1,181 @@
+"""w4a16 matmul: int4 weight-only quantization with a Pallas TPU kernel.
+
+Autoregressive decode streams every weight byte from HBM each step, so the
+decode ceiling is HBM bandwidth (the reference has no model layer at all — its
+engine is the OpenAI HTTP API; this optimizes the local TPU engine's hot loop).
+int8 already halves bf16 traffic; int4 halves the FOOTPRINT again. XLA cannot
+fuse nibble unpacking into a dot (the unpacked bf16 operand materializes in
+HBM, measured ~5x SLOWER than int8), so the unpack must happen in VMEM: this
+kernel DMAs the packed [K/2, N] int8 payload block-by-block, sign-extends both
+nibbles on the VPU, and feeds the MXU — HBM only ever sees 4-bit weights.
+
+Measured role on v5e (llama-3-8b, n=32 decode): the int8 path already runs at
+~75% of peak HBM bandwidth (13.7 ms/step), while the nibble unpack is
+VPU-throughput-bound (~1-2 elements/lane/cycle over every weight), so w4a16
+decodes ~25% SLOWER (17.4 ms/step) despite streaming half the bytes; the
+`pltpu.bitcast`-to-int4 unpack and an XLA `s4` dot were both measured slower
+still. int4 is therefore the CAPACITY config — 8B weights in ~5.0 GB instead
+of ~8.6 GB (room for larger KV caches, longer contexts, or 13B-class models
+on one 16 GB chip) — and int8 is the latency config.
+
+Storage format (see :func:`pack_int4`): weights are grouped along the
+contraction axis (GROUP=128 rows per group, one f32 scale per (group, out)
+column — group-wise symmetric quantization, the AWQ/llama.cpp-Q4 layout). A
+group's rows 0..63 live in the LOW nibbles and rows 64..127 in the HIGH
+nibbles of the same packed byte rows, so the kernel unpack is a sublane
+concatenate instead of an interleave (TPU-tiling friendly).
+
+The int4 values are clipped to [-7, 7] (symmetric, no -8) and the scale is
+applied AFTER the group dot in f32 — the MXU sees exact small integers in
+bf16, so no precision is lost to the weight cast.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GROUP = 128  # contraction rows per quantization group (one scale each)
+_HALF = GROUP // 2
+
+
+class Q4Tensor(NamedTuple):
+    """Packed int4 weight: ``q`` int8 [..., K/2, N] (two nibbles per byte along
+    the contraction axis), ``scale`` f32 [..., K/GROUP, N]."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def k_dim(self) -> int:
+        return self.q.shape[-2] * 2
+
+    @property
+    def shape(self):
+        return self.q.shape[:-2] + (self.k_dim, self.q.shape[-1])
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def supports_int4(k: int) -> bool:
+    """The kernel needs whole groups and at least one 256-row K block."""
+    return k % 256 == 0
+
+
+def pack_int4(w: jax.Array) -> Q4Tensor:
+    """Group-wise symmetric int4 quantization of ``w`` [..., K, N].
+
+    Per group of GROUP contraction rows: scale = amax/7, values round-clipped
+    to [-7, 7]. Rows [0, 64) of each group pack into low nibbles, rows
+    [64, 128) into high nibbles of the same byte rows.
+    """
+    *lead, K, N = w.shape
+    if K % GROUP != 0:
+        raise ValueError(f"contraction dim {K} not a multiple of group {GROUP}")
+    g = w.astype(jnp.float32).reshape(*lead, K // GROUP, GROUP, N)
+    amax = jnp.max(jnp.abs(g), axis=-2, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(g / scale), -7, 7).astype(jnp.int8)
+    lo = q[..., :_HALF, :]
+    hi = q[..., _HALF:, :]
+    packed = (lo & 0xF) | (hi << 4)
+    packed = packed.reshape(*lead, K // 2, N)
+    return Q4Tensor(q=packed, scale=scale[..., 0, :].reshape(*lead, K // GROUP, N))
+
+
+def unpack_int4(w: Q4Tensor) -> jax.Array:
+    """Dequantize to f32 [..., K, N] (reference/off-TPU path)."""
+    *lead, Kh, N = w.q.shape
+    p = w.q.astype(jnp.int32).reshape(*lead, Kh * 2 // GROUP, _HALF, N)
+    lo = ((p & 0xF) ^ 8) - 8
+    hi = p >> 4
+    q = jnp.concatenate([lo, hi], axis=-2)  # [..., K/GROUP, GROUP, N]
+    deq = q.astype(jnp.float32) * w.scale[..., None, :]
+    return deq.reshape(*lead, Kh * 2, N)
+
+
+def _w4_kernel(x_ref, qp_ref, sc_ref, o_ref, acc_ref, *, groups: int, out_dtype):
+    """Grid (row blocks, N blocks, K blocks); K innermost so the accumulator
+    scratch survives the K walk for each (row, N) tile."""
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    for g in range(groups):  # static unroll over groups in this K block
+        p = qp_ref[g * _HALF : (g + 1) * _HALF, :].astype(jnp.int32)
+        lo = ((p & 0xF) ^ 8) - 8
+        hi = p >> 4  # arithmetic shift of the sign-extended byte
+        w = jnp.concatenate([lo, hi], axis=0).astype(jnp.bfloat16)  # [GROUP, bn]
+        xg = x_ref[:, g * GROUP : (g + 1) * GROUP]
+        s = jax.lax.dot_general(
+            xg, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[:] += s * sc_ref[g, :][None, :]
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[:] = acc_ref[:].astype(out_dtype)
+
+
+def _pick(total: int, choices) -> int:
+    for c in choices:
+        if total % c == 0:
+            return c
+    return 0
+
+
+def w4_matmul(
+    x: jax.Array,
+    w: Q4Tensor,
+    *,
+    block_rows: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """``x @ dequant(w)`` with 4-bit HBM traffic. x: [rows, K] (bf16/f32);
+    returns [rows, N] in x.dtype. Falls back to the XLA dequant path when the
+    shape doesn't fit the kernel's blocking (tiny test models)."""
+    rows, K = x.shape
+    Kh, N = w.q.shape
+    assert K == Kh * 2, (K, w.q.shape)
+
+    block_k = _pick(K, (1024, 512, 256))
+    block_n = _pick(N, (512, 256, 128))
+    if not block_k or not block_n:
+        return (x.astype(jnp.float32) @ unpack_int4(w)).astype(x.dtype)
+
+    # bf16 VMEM tiles are (16, 128): keep the row block a multiple of 16.
+    rp = max(16, min(block_rows, ((rows + 15) // 16) * 16))
+    rows_pad = pl.cdiv(rows, rp) * rp
+    if rows_pad != rows:
+        x = jnp.pad(x, ((0, rows_pad - rows), (0, 0)))
+
+    grid = (rows_pad // rp, N // block_n, K // block_k)
+    kernel = functools.partial(
+        _w4_kernel, groups=block_k // GROUP, out_dtype=x.dtype
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_pad, N), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rp, block_k), lambda rb, nb, kb: (rb, kb)),
+            pl.BlockSpec((block_k // 2, block_n), lambda rb, nb, kb: (kb, nb)),
+            pl.BlockSpec((block_k // GROUP, block_n), lambda rb, nb, kb: (kb, nb)),
+        ],
+        out_specs=pl.BlockSpec((rp, block_n), lambda rb, nb, kb: (rb, nb)),
+        scratch_shapes=[pltpu.VMEM((rp, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, w.q, w.scale)
+    return out[:rows]

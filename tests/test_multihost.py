@@ -125,6 +125,16 @@ def _run_workers(port: int):
                 )
             )
         return [p.communicate(timeout=150)[0] for p in procs], procs
+    except subprocess.TimeoutExpired:
+        # A lost coordinator-port race can leave one worker blocked on connect
+        # rather than exiting; surface it as a failed round so the caller's
+        # fresh-port retry applies to this mode too.
+        outs = []
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            outs.append(p.communicate()[0] or "")
+        return outs, procs
     finally:
         for p in procs:  # a hung coordinator must not leak past the test
             if p.poll() is None:
@@ -134,7 +144,8 @@ def _run_workers(port: int):
 
 def test_two_process_dcn_collectives():
     # _free_port has an unavoidable close-to-rebind window; retry once with a
-    # fresh port if the coordinator lost the race.
+    # fresh port if the coordinator lost the race (clean bind failure or a
+    # worker left hanging on the stolen port — both count as a lost round).
     for attempt in range(2):
         outputs, procs = _run_workers(_free_port())
         if all(p.returncode == 0 for p in procs) or attempt == 1:

@@ -117,4 +117,4 @@ def test_backend_config_quantization():
     assert len(r.choices) == 2
 
     with pytest.raises(ValueError, match="Unsupported quantization"):
-        TpuBackend(model="tiny", quantization="int4")
+        TpuBackend(model="tiny", quantization="fp8")

@@ -1,0 +1,163 @@
+"""int4 (w4a16) quantization: packing, the Pallas kernel (interpret mode on
+CPU), and the engine/backend plumbing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k_llms_tpu.ops.w4matmul import (
+    GROUP,
+    Q4Tensor,
+    pack_int4,
+    supports_int4,
+    unpack_int4,
+    w4_matmul,
+)
+
+
+def test_pack_unpack_roundtrip_exact():
+    # Values already on the int4 grid round-trip exactly through pack/unpack.
+    key = jax.random.key(0)
+    ints = jax.random.randint(key, (256, 128), -7, 8).astype(jnp.float32)
+    w = ints * 0.01  # uniform scale per group -> amax/7 recovers the grid
+    q4 = pack_int4(w)
+    assert q4.q.shape == (128, 128)
+    assert q4.scale.shape == (2, 128)
+    deq = unpack_int4(q4)
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(w), rtol=1e-5, atol=1e-7)
+
+
+def test_pack_quantization_error_bounded():
+    w = jax.random.normal(jax.random.key(1), (512, 256), jnp.float32)
+    q4 = pack_int4(w)
+    deq = np.asarray(unpack_int4(q4))
+    w_np = np.asarray(w)
+    # Max error within a group is scale/2; scale = amax/7.
+    scales = np.abs(w_np.reshape(-1, GROUP, 256)).max(axis=1) / 7.0
+    err = np.abs(deq - w_np).reshape(-1, GROUP, 256).max(axis=1)
+    assert (err <= scales / 2 + 1e-7).all()
+
+
+def test_kernel_matches_xla_reference():
+    # Real kernel blocking (K=512 -> one 512 block; N=512) in interpret mode.
+    key = jax.random.key(2)
+    w = jax.random.normal(key, (512, 512), jnp.float32)
+    q4 = pack_int4(w)
+    x = jax.random.normal(jax.random.key(3), (48, 512), jnp.float32)
+    ref = x @ unpack_int4(q4).astype(x.dtype)
+    out = w4_matmul(x, q4, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_kernel_multiblock_grid():
+    # Multiple row/N/K blocks: K=1024 (one block of 8 groups), N=768 (128-col
+    # blocks x6), rows spanning two row blocks when block_rows is small.
+    w = jax.random.normal(jax.random.key(4), (1024, 768), jnp.float32)
+    q4 = pack_int4(w)
+    x = jax.random.normal(jax.random.key(5), (40, 1024), jnp.float32)
+    ref = x @ unpack_int4(q4).astype(x.dtype)
+    out = w4_matmul(x, q4, block_rows=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_kernel_bf16_activations():
+    w = jax.random.normal(jax.random.key(6), (512, 512), jnp.float32)
+    q4 = pack_int4(w)
+    x = jax.random.normal(jax.random.key(7), (16, 512), jnp.bfloat16)
+    ref = (x.astype(jnp.float32) @ unpack_int4(q4)).astype(jnp.bfloat16)
+    out = w4_matmul(x, q4, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_qdot_dispatches_q4():
+    from k_llms_tpu.models.quant import qdot
+
+    w = jax.random.normal(jax.random.key(8), (256, 256), jnp.float32)
+    q4 = pack_int4(w)
+    x = jax.random.normal(jax.random.key(9), (2, 3, 256), jnp.float32)
+    out = qdot(x, q4)
+    assert out.shape == (2, 3, 256)
+    ref = x @ unpack_int4(q4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_supports_int4_gate():
+    assert supports_int4(256) and supports_int4(4096)
+    assert not supports_int4(128) and not supports_int4(320)
+
+
+def test_quantize_params_bits4_mixed_tree():
+    """bits=4 packs eligible weights Q4 and falls back int8 for the rest."""
+    from k_llms_tpu.models import get_config, init_params
+    from k_llms_tpu.models.quant import QTensor, quantize_params
+
+    cfg = get_config("tiny").with_(
+        hidden_size=256, intermediate_size=512, num_layers=2, vocab_size=384
+    )
+    params = init_params(cfg, jax.random.key(0))
+    qp = quantize_params(params, bits=4)
+    assert isinstance(qp["layers"]["w_gate"], Q4Tensor)  # 256 -> 512
+    assert isinstance(qp["layers"]["w_down"], Q4Tensor)  # 512 -> 256
+    assert isinstance(qp["lm_head"], Q4Tensor)  # 256 -> 384
+    # wk: K=256 eligible, N=kv_dim may not be 128-divisible on tiny; just check
+    # the tree is fully quantized one way or the other.
+    for name in ("wq", "wk", "wv", "wo"):
+        assert isinstance(qp["layers"][name], (Q4Tensor, QTensor))
+
+
+def test_int4_generate_end_to_end():
+    """A small-but-eligible model generates through the full engine with
+    quantize="int4" (CPU: XLA fallback inside w4_matmul for tiny shapes,
+    interpret-mode kernel for eligible ones)."""
+    from k_llms_tpu.engine.engine import LocalEngine
+    from k_llms_tpu.models import get_config
+
+    cfg = get_config("tiny").with_(
+        hidden_size=256,
+        intermediate_size=512,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=64,
+        vocab_size=384,
+        max_seq_len=128,
+    )
+    eng = LocalEngine(cfg, use_mesh=False, quantize="int4")
+    assert eng.quantized == "int4"
+    res = eng.generate([5, 6, 7], n=2, max_new_tokens=4, temperature=0.7, seed=11)
+    assert res.tokens.shape == (2, 4)
+    assert (res.tokens < 384).all()
+
+
+def test_int4_orbax_roundtrip(tmp_path):
+    """Q4Tensor leaves survive an orbax save/restore (rebuilt by scale shape)."""
+    from k_llms_tpu.models.loader import load_orbax, save_checkpoint
+
+    w = jax.random.normal(jax.random.key(10), (256, 128), jnp.float32)
+    q4 = pack_int4(w)
+    tree = {"layers": {"w_up": q4}, "note": jnp.ones((2,))}
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, tree)
+    restored = load_orbax(path)
+    assert isinstance(restored["layers"]["w_up"], Q4Tensor)
+    np.testing.assert_array_equal(
+        np.asarray(restored["layers"]["w_up"].q), np.asarray(q4.q)
+    )
+
+
+def test_init_params_quantized_bits4_shapes():
+    from k_llms_tpu.models import get_config
+    from k_llms_tpu.models.quant import init_params_quantized
+
+    cfg = get_config("tiny").with_(
+        hidden_size=256, intermediate_size=512, num_layers=2, vocab_size=384
+    )
+    params = init_params_quantized(cfg, jax.random.key(0), bits=4)
+    gate = params["layers"]["w_gate"]
+    assert isinstance(gate, Q4Tensor)
+    assert gate.q.shape == (2, 128, 512)
+    assert gate.scale.shape == (2, 2, 512)
