@@ -67,13 +67,9 @@ def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
     runs in interpret mode only for realistic shapes — tiny test shapes take
     the XLA dequant reference inside :func:`w4_matmul`."""
     if isinstance(w, Q4Tensor):
-        lead = x.shape[:-1]
-        rows = 1
-        for d in lead:
-            rows *= d
-        x2 = x.reshape(rows, x.shape[-1])
+        x2 = x.reshape(-1, x.shape[-1])
         out = w4_matmul(x2, w, interpret=jax.default_backend() != "tpu")
-        return out.reshape(*lead, w.q.shape[-1])
+        return out.reshape(*x.shape[:-1], w.q.shape[-1])
     if isinstance(w, QTensor):
         out = x @ w.q.astype(x.dtype)
         return out * w.scale[..., 0, :].astype(out.dtype)
@@ -104,7 +100,13 @@ def _int4_eligible(w: jax.Array) -> bool:
     return _int4_eligible_shape(w.ndim, w.shape[-2], w.shape[-1])
 
 
-def quantize_weight_bits(w: jax.Array, bits: int) -> WeightLike:
+def quantize_weight_bits(w: WeightLike, bits: int) -> WeightLike:
+    if isinstance(w, (QTensor, Q4Tensor)):
+        # Already quantized — e.g. an orbax checkpoint of a quantized tree
+        # loaded with the quantization flag still set. Keep the stored layout
+        # (re-quantizing int8<->int4 from the lossy payload would only lose
+        # more precision).
+        return w
     if bits == 4 and _int4_eligible(w):
         return pack_int4(w)
     return quantize_weight(w)
@@ -145,11 +147,15 @@ def init_params_quantized(config, key: jax.Array, dtype=None, bits: int = 8) -> 
     def qinit(k, shape) -> WeightLike:
         K, N = shape[-2], shape[-1]
         if bits == 4 and _int4_eligible_shape(len(shape), K, N):
+            from ..ops.w4matmul import GROUP
+
             # Random packed bytes = two uniform nibbles in [-8, 7] apiece
-            # (std ~4.61); scale so effective weights are ~N(0, 1/fan_in).
+            # (std = sqrt(E[k^2]-mu^2) over -8..7 ~= 4.61); scale so effective
+            # weights are ~N(0, 1/fan_in).
+            nibble_std = math.sqrt(sum(v * v for v in range(-8, 8)) / 16 - 0.25)
             q = jax.random.randint(k, shape[:-2] + (K // 2, N), -128, 128, jnp.int8)
-            scale_val = 1.0 / (4.61 * math.sqrt(K))
-            scale = jnp.full(shape[:-2] + (K // 128, N), scale_val, jnp.float32)
+            scale_val = 1.0 / (nibble_std * math.sqrt(K))
+            scale = jnp.full(shape[:-2] + (K // GROUP, N), scale_val, jnp.float32)
             return Q4Tensor(q=q, scale=scale)
         q = jax.random.randint(k, shape, -127, 128, jnp.int8)
         # std(uniform int8) = 127/sqrt(3); scale it to 1/sqrt(fan_in).

@@ -124,16 +124,18 @@ def _run_workers(port: int):
                     text=True,
                 )
             )
-        return [p.communicate(timeout=150)[0] for p in procs], procs
-    except subprocess.TimeoutExpired:
-        # A lost coordinator-port race can leave one worker blocked on connect
-        # rather than exiting; surface it as a failed round so the caller's
-        # fresh-port retry applies to this mode too.
         outs = []
         for p in procs:
-            if p.poll() is None:
+            try:
+                outs.append(p.communicate(timeout=150)[0])
+            except subprocess.TimeoutExpired:
+                # A lost coordinator-port race can leave a worker blocked on
+                # connect rather than exiting; kill it, keep whatever it
+                # printed, and surface the round as failed so the caller's
+                # fresh-port retry applies to this mode too. Per-process
+                # communicate keeps the healthy worker's output intact.
                 p.kill()
-            outs.append(p.communicate()[0] or "")
+                outs.append(p.communicate()[0] or "")
         return outs, procs
     finally:
         for p in procs:  # a hung coordinator must not leak past the test

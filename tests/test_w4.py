@@ -149,6 +149,25 @@ def test_int4_orbax_roundtrip(tmp_path):
     )
 
 
+def test_quantize_params_passes_through_quantized_leaves():
+    """Serving a quantized checkpoint with the quantization flag still set
+    must keep the stored leaves, not crash or re-quantize the lossy payload."""
+    from k_llms_tpu.models import get_config, init_params
+    from k_llms_tpu.models.quant import QTensor, quantize_params
+
+    cfg = get_config("tiny").with_(
+        hidden_size=256, intermediate_size=512, num_layers=2, vocab_size=384
+    )
+    q4_tree = quantize_params(init_params(cfg, jax.random.key(0)), bits=4)
+    for bits in (4, 8):
+        again = quantize_params(q4_tree, bits=bits)
+        assert again["layers"]["w_gate"] is q4_tree["layers"]["w_gate"]
+        assert isinstance(again["lm_head"], Q4Tensor)
+    q8_tree = quantize_params(init_params(cfg, jax.random.key(0)), bits=8)
+    again8 = quantize_params(q8_tree, bits=4)
+    assert isinstance(again8["layers"]["w_gate"], QTensor)
+
+
 def test_init_params_quantized_bits4_shapes():
     from k_llms_tpu.models import get_config
     from k_llms_tpu.models.quant import init_params_quantized
