@@ -372,8 +372,11 @@ class TpuBackend(Backend):
                 i += len(p)
             return out
 
+        # window=0: opportunistic coalescing only. An embedding forward takes
+        # a few ms, so the scheduler's default 5 ms decode-admission window
+        # would be a large relative latency cost here.
         pooled = self.scheduler.call_batched(
-            ("embed",), token_lists, run, weight=max(1, len(token_lists))
+            ("embed",), token_lists, run, weight=max(1, len(token_lists)), window=0.0
         )
         return [[float(x) for x in row] for row in pooled]
 

@@ -177,3 +177,94 @@ def test_submit_batched_row_budget():
     # 2 * 32 = 64 rows per group at most.
     assert [len(c) for c in calls] == [2, 2, 1]
     sched.shutdown()
+
+
+def test_batch_window_fuses_concurrent_burst():
+    """The admission window must fuse a concurrent burst into ONE runner call
+    even though the first arrival finds an empty queue (without the window it
+    would always decode solo)."""
+    import threading
+
+    from k_llms_tpu.engine.scheduler import EngineScheduler
+
+    # max_batch == burst size: the group launches the instant the 5th client
+    # is admitted, so the generous window bounds CI timing skew without ever
+    # being waited in full.
+    sched = EngineScheduler(name="t-window", max_batch=5, batch_window=10.0)
+    calls = []
+
+    def runner(payloads):
+        calls.append(sorted(payloads))
+        return [p * 2 for p in payloads]
+
+    results = {}
+
+    def client(i):
+        results[i] = sched.call_batched(("k",), i, runner)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: i * 2 for i in range(5)}
+    assert calls == [[0, 1, 2, 3, 4]]
+    assert sched.stats["batches"] == 1 and sched.stats["coalesced"] == 4
+    sched.shutdown()
+
+
+def test_batch_window_does_not_delay_plain_submits():
+    import time as _time
+
+    from k_llms_tpu.engine.scheduler import EngineScheduler
+
+    sched = EngineScheduler(name="t-window2", batch_window=0.5)
+    t0 = _time.perf_counter()
+    assert sched.call(lambda: 7) == 7
+    assert _time.perf_counter() - t0 < 0.3  # no window applied to closures
+    sched.shutdown()
+
+
+def test_batch_window_breaks_at_key_boundary():
+    """A different-key item at the queue head ends the window immediately (no
+    5 s wait despite the huge window) — FIFO order is never violated to keep a
+    window open, and the different-key item is never absorbed."""
+    import time as _time
+
+    from k_llms_tpu.engine.scheduler import EngineScheduler
+
+    sched = EngineScheduler(name="t-window3", batch_window=5.0)
+    calls = []
+
+    def runner(payloads):
+        calls.append(list(payloads))
+        return payloads
+
+    gate = sched.submit(lambda: __import__("time").sleep(0.05))
+    fa = sched.submit_batched(("a",), 1, runner)
+    fb = sched.submit_batched(("b",), 2, runner)
+    gate.result(timeout=5)
+    t0 = _time.perf_counter()
+    assert fa.result(timeout=5) == 1  # "b" at the head closed "a"'s window
+    assert _time.perf_counter() - t0 < 2.0
+    # "b" is now alone in the queue, so IT pays the window before running —
+    # the documented solo-batched-request cost (default window is 5 ms).
+    assert fb.result(timeout=30) == 2
+    assert calls == [[1], [2]]
+    sched.shutdown()
+
+
+def test_batch_window_skipped_when_budget_exhausted():
+    """A head item that already exhausts the row budget cannot gain a partner,
+    so the worker must not sleep the window at all (huge window + fast result
+    proves the skip)."""
+    import time as _time
+
+    from k_llms_tpu.engine.scheduler import EngineScheduler
+
+    sched = EngineScheduler(name="t-window4", max_rows=4, batch_window=10.0)
+    t0 = _time.perf_counter()
+    out = sched.call_batched(("k",), 5, lambda ps: [p + 1 for p in ps], weight=4)
+    assert out == 6
+    assert _time.perf_counter() - t0 < 2.0
+    sched.shutdown()
