@@ -53,6 +53,10 @@ class BackendConfig(BaseModel):
     # the CAPACITY config: ~40% smaller footprint for larger KV/models per
     # chip, ~25% slower decode; falls back to int8 on a mesh).
     quantization: Optional[str] = None
+    # Prompts at least this long prefill sequence-parallel (ring attention
+    # over the mesh's data axis, O(S/P) activation memory per device) instead
+    # of dense. None disables; requires a multi-device mesh.
+    sp_prefill_min_tokens: Optional[int] = None
 
 
 class TpuBackend(Backend):
@@ -106,6 +110,7 @@ class TpuBackend(Backend):
             model_parallel=cfg.model_parallel,
             param_seed=cfg.param_seed,
             quantize=cfg.quantization or False,
+            sp_prefill_min_tokens=cfg.sp_prefill_min_tokens,
         )
         self.default_max_new_tokens = cfg.max_new_tokens
         # All device work funnels through one scheduler so concurrent clients

@@ -87,6 +87,7 @@ class LocalEngine:
         param_seed: int = 0,
         use_mesh: bool = True,
         quantize: "bool | str" = False,
+        sp_prefill_min_tokens: Optional[int] = None,
     ):
         self.config = get_config(config) if isinstance(config, str) else config
         if mesh is None and use_mesh and len(jax.devices()) > 1:
@@ -140,7 +141,15 @@ class LocalEngine:
                 params = jax.device_put(params, self._shard_tree(pspecs))
         self.params = params
 
+        # Sequence-parallel prefill threshold: prompts at least this long
+        # route through ring attention over the mesh's data axis (activations
+        # and KV sharded O(S/P) per device during prefill) when a mesh exists
+        # and the config's attention has no score-level features the ring
+        # kernel can't express. None disables the route.
+        self.sp_prefill_min_tokens = sp_prefill_min_tokens
+
         self._prefill_cache: Dict[Any, Any] = {}
+        self._sp_prefill_cache: Dict[Any, Any] = {}
         self._decode_cache: Dict[Any, Any] = {}
         self._embed_cache: Dict[Any, Any] = {}
 
@@ -178,6 +187,56 @@ class LocalEngine:
             else:
                 fn = jax.jit(_prefill)
             self._prefill_cache[bucket] = fn
+        return fn
+
+    def _use_sp_prefill(self, prompt_len: int, bucket: int) -> bool:
+        config = self.config
+        return (
+            self.mesh is not None
+            and self.sp_prefill_min_tokens is not None
+            and prompt_len >= self.sp_prefill_min_tokens
+            and self.mesh.shape[DATA_AXIS] > 1
+            # forward_sequence_parallel hard-requires S % ring == 0.
+            and bucket % self.mesh.shape[DATA_AXIS] == 0
+            and config.attn_softcap is None
+            and config.sliding_window is None
+        )
+
+    def _get_sp_prefill(self, bucket: int):
+        """Jitted sequence-parallel prefill (ring attention over the data
+        axis): same (first_logits, prefix KVCache) contract as the dense
+        prefill, with the prefix resharded to the decode layout on the way
+        out."""
+        fn = self._sp_prefill_cache.get(bucket)
+        if fn is None:
+            from .long_context import forward_sequence_parallel
+
+            config = self.config
+            mesh = self.mesh
+
+            from ..models.llama import _logits
+
+            def _sp(params, tokens, prompt_len):
+                # Ignore the full [B, S, V] logits (XLA dead-code-eliminates
+                # the O(S*V) projection when unused) and project only the last
+                # prompt position's hidden state — the logits matmul over the
+                # whole sequence would dwarf the O(S/P) memory budget this
+                # path exists for.
+                _, h, kv = forward_sequence_parallel(
+                    config, params, tokens, mesh, seq_axis=DATA_AXIS
+                )
+                h_last = lax.dynamic_slice_in_dim(h, prompt_len - 1, 1, axis=1)
+                return _logits(config, params, h_last)[:, 0, :], kv
+
+            out_shardings = (
+                NamedSharding(mesh, P(None, None)),
+                KVCache(
+                    k=NamedSharding(mesh, cache_specs(shared_prefix=True)),
+                    v=NamedSharding(mesh, cache_specs(shared_prefix=True)),
+                ),
+            )
+            fn = jax.jit(_sp, out_shardings=out_shardings)
+            self._sp_prefill_cache[bucket] = fn
         return fn
 
     # -- decode loop ------------------------------------------------------
@@ -477,9 +536,14 @@ class LocalEngine:
             seed = int.from_bytes(os.urandom(4), "little")
         req_keys = jnp.stack([jax.random.key(seed)])
 
-        first_logits, prefix = self._get_prefill(bucket)(
-            self.params, tokens, jnp.int32(prompt_len)
-        )
+        if self._use_sp_prefill(prompt_len, bucket):
+            first_logits, prefix = self._get_sp_prefill(bucket)(
+                self.params, tokens, jnp.int32(prompt_len)
+            )
+        else:
+            first_logits, prefix = self._get_prefill(bucket)(
+                self.params, tokens, jnp.int32(prompt_len)
+            )
         loop = self._get_decode_loop(
             1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
@@ -580,9 +644,15 @@ class LocalEngine:
             tokens = jnp.array(
                 [ids + [config.pad_token_id] * (bucket - prompt_len)], jnp.int32
             )
-            fl, pref = self._get_prefill(bucket)(
-                self.params, tokens, jnp.int32(prompt_len)
+            # Per-request SP routing: a coalesced batch of long prompts must
+            # not fall back to dense prefill (the very workload
+            # sp_prefill_min_tokens exists for would OOM there).
+            prefill_fn = (
+                self._get_sp_prefill(bucket)
+                if self._use_sp_prefill(prompt_len, bucket)
+                else self._get_prefill(bucket)
             )
+            fl, pref = prefill_fn(self.params, tokens, jnp.int32(prompt_len))
             if bucket < bucket_max:
                 pad = [(0, 0)] * 5
                 pad[2] = (0, bucket_max - bucket)  # masked by prompt_len anyway

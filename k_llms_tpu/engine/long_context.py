@@ -9,7 +9,10 @@ is O(S/P) and context scales with the ring size.
 
 Used for prefilling prompts too long for one device's HBM; the resulting KV
 cache is already sequence-sharded for subsequent ring decode, or can be
-gathered for the dense shared-prefix decode path.
+gathered for the dense shared-prefix decode path. ``LocalEngine`` routes
+prompts past ``sp_prefill_min_tokens`` through here automatically when a mesh
+is available (``engine/engine.py``), then decodes against the returned prefix
+exactly like a dense prefill.
 
 The per-position math (projections, biases, activations, norms, MoE routing,
 quantized weights) is the same code the dense path uses — only attention is
@@ -30,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.llama import (
+    KVCache,
     _activation,
     _embed,
     _logits,
@@ -47,11 +51,13 @@ def forward_sequence_parallel(
     tokens: jax.Array,
     mesh: Mesh,
     seq_axis: str = "data",
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, "KVCache"]:
     """Full causal forward with the sequence sharded over ``seq_axis``.
 
     tokens: [B, S] with S divisible by the ring size. Returns (logits f32
-    [B, S, V], final hidden [B, S, H]), both sequence-sharded.
+    [B, S, V], final hidden [B, S, H], per-layer KVCache [L, B, S, KVH, D]) —
+    all sequence-sharded. The KVCache has the exact layout of the dense
+    ``prefill``'s prefix cache, so the decode loop consumes it unchanged.
     """
     if config.attn_softcap is not None or config.sliding_window is not None:
         raise NotImplementedError(
@@ -64,6 +70,7 @@ def forward_sequence_parallel(
         raise ValueError(f"sequence length {S} must divide by ring size {ring}")
 
     seq_sharded = NamedSharding(mesh, P(None, seq_axis, None))
+    kv_sharded = NamedSharding(mesh, P(None, seq_axis, None, None))
 
     def constrain(x):
         return lax.with_sharding_constraint(x, seq_sharded)
@@ -82,6 +89,8 @@ def forward_sequence_parallel(
         v = v.reshape(B, S, config.num_kv_heads, config.head_dim)
         q = rope_embed(q, positions, config.rope_theta)
         k = rope_embed(k, positions, config.rope_theta)
+        cache_k = lax.with_sharding_constraint(k.astype(config.jax_dtype), kv_sharded)
+        cache_v = lax.with_sharding_constraint(v.astype(config.jax_dtype), kv_sharded)
 
         attn = ring_attention(
             mesh,
@@ -108,8 +117,8 @@ def forward_sequence_parallel(
         if "post_mlp_norm" in layer:
             out = rms_norm(out, layer["post_mlp_norm"], config.rms_eps, offset)
         x = constrain(x + out)
-        return x, None
+        return x, (cache_k, cache_v)
 
-    x, _ = lax.scan(body, x, params["layers"])
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
     h = rms_norm(x, params["final_norm"], config.rms_eps, offset)
-    return _logits(config, params, h), h
+    return _logits(config, params, h), h, KVCache(k=ks, v=vs)

@@ -18,7 +18,7 @@ def test_sequence_parallel_matches_dense():
     B, S = 2, 64
     tokens = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
 
-    logits_sp, hidden_sp = jax.jit(
+    logits_sp, hidden_sp, kv_sp = jax.jit(
         lambda p, t: forward_sequence_parallel(cfg, p, t, mesh, seq_axis="data")
     )(params, tokens)
     logits_ref, hidden_ref = forward(cfg, params, tokens, jnp.ones((B, S), jnp.int32))
@@ -29,6 +29,9 @@ def test_sequence_parallel_matches_dense():
     np.testing.assert_allclose(
         np.asarray(hidden_sp), np.asarray(hidden_ref), rtol=2e-4, atol=2e-4
     )
+    # The returned prefix cache has the dense prefill layout.
+    assert kv_sp.k.shape == (cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim)
+    assert kv_sp.k.dtype == cfg.jax_dtype
 
 
 VARIANTS = {
@@ -55,7 +58,7 @@ def test_sequence_parallel_matches_dense_variants(variant):
     B, S = 2, 32
     tokens = jax.random.randint(jax.random.key(3), (B, S), 0, cfg.vocab_size)
 
-    logits_sp, _ = jax.jit(
+    logits_sp, _, _ = jax.jit(
         lambda p, t: forward_sequence_parallel(cfg, p, t, mesh, seq_axis="data")
     )(params, tokens)
     logits_ref, _ = forward(cfg, params, tokens, jnp.ones((B, S), jnp.int32))
@@ -82,3 +85,72 @@ def test_sequence_parallel_rejects_indivisible():
 
     with pytest.raises(ValueError):
         forward_sequence_parallel(cfg, params, tokens, mesh)
+
+
+def test_engine_routes_long_prompts_through_sp_prefill():
+    """End-to-end: an engine with sp_prefill_min_tokens set must produce the
+    SAME generation for a long prompt as the dense engine (identical seeds),
+    and must actually take the SP route (jit cache populated)."""
+    from k_llms_tpu.engine.engine import LocalEngine
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(4, 2)
+    prompt = [int(x) for x in
+              jax.random.randint(jax.random.key(9), (70,), 5, 200)]
+
+    dense = LocalEngine(cfg, params=params, mesh=mesh)
+    sp = LocalEngine(cfg, params=params, mesh=mesh, sp_prefill_min_tokens=64)
+
+    r_dense = dense.generate(prompt, n=4, max_new_tokens=6, temperature=0.7, seed=3)
+    r_sp = sp.generate(prompt, n=4, max_new_tokens=6, temperature=0.7, seed=3)
+
+    assert sp._sp_prefill_cache and not sp._prefill_cache  # SP route taken
+    assert dense._prefill_cache and not dense._sp_prefill_cache
+    np.testing.assert_array_equal(r_sp.tokens, r_dense.tokens)
+    np.testing.assert_allclose(r_sp.logprobs, r_dense.logprobs, rtol=1e-4, atol=1e-4)
+
+    # Short prompts stay on the dense path even when the threshold is set.
+    sp.generate(prompt[:10], n=2, max_new_tokens=2, temperature=0.7, seed=3)
+    assert sp._prefill_cache
+
+
+def test_engine_sp_threshold_respects_unsupported_configs():
+    """Softcap/sliding-window configs must silently keep the dense path —
+    never crash on the ring kernel's NotImplementedError."""
+    from k_llms_tpu.engine.engine import LocalEngine
+
+    cfg = get_config("tiny").with_(sliding_window=16)
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(8, 1)
+    eng = LocalEngine(cfg, params=params, mesh=mesh, sp_prefill_min_tokens=32)
+    res = eng.generate(list(range(5, 70)), n=2, max_new_tokens=3, temperature=0.5, seed=1)
+    assert res.tokens.shape == (2, 3)
+    assert eng._prefill_cache and not eng._sp_prefill_cache
+
+
+def test_generate_many_routes_sp_per_request():
+    """Coalesced batches must route each long-prompt prefill through the SP
+    path and match the solo (generate) results bit-for-bit."""
+    from k_llms_tpu.engine.engine import GenRequestSpec, LocalEngine
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(4, 2)
+    long_prompt = [int(x) for x in jax.random.randint(jax.random.key(4), (70,), 5, 200)]
+    short_prompt = list(range(5, 15))
+
+    eng = LocalEngine(cfg, params=params, mesh=mesh, sp_prefill_min_tokens=64)
+    solo = [
+        eng.generate(p, n=2, max_new_tokens=4, temperature=0.6, seed=s)
+        for p, s in ((long_prompt, 11), (short_prompt, 12))
+    ]
+    batched = eng.generate_many(
+        [GenRequestSpec(long_prompt, 2, 11), GenRequestSpec(short_prompt, 2, 12)],
+        max_new_tokens=4,
+        temperature=0.6,
+    )
+    assert eng._sp_prefill_cache  # long request took the SP route
+    assert eng._prefill_cache  # short request stayed dense
+    for s, b in zip(solo, batched):
+        np.testing.assert_array_equal(s.tokens, b.tokens)
