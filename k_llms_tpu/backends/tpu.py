@@ -57,6 +57,13 @@ class BackendConfig(BaseModel):
     # over the mesh's data axis, O(S/P) activation memory per device) instead
     # of dense. None disables; requires a multi-device mesh.
     sp_prefill_min_tokens: Optional[int] = None
+    # Prompt-prefix KV cache: keep the last N full-prompt KV caches on device
+    # and reuse the longest common token prefix (>= prefix_cache_min_reuse
+    # tokens) of any of them, prefilling only the suffix. Serves the
+    # repeated-extraction pattern (one long instruction prompt, many
+    # documents). 0 disables.
+    prefix_cache_size: int = 0
+    prefix_cache_min_reuse: int = 32
 
 
 class TpuBackend(Backend):
@@ -111,6 +118,8 @@ class TpuBackend(Backend):
             param_seed=cfg.param_seed,
             quantize=cfg.quantization or False,
             sp_prefill_min_tokens=cfg.sp_prefill_min_tokens,
+            prefix_cache_size=cfg.prefix_cache_size,
+            prefix_cache_min_reuse=cfg.prefix_cache_min_reuse,
         )
         self.default_max_new_tokens = cfg.max_new_tokens
         # All device work funnels through one scheduler so concurrent clients

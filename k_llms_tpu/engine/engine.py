@@ -38,6 +38,7 @@ from ..models.llama import (
     init_cache,
     init_params,
     prefill,
+    prefill_continue,
 )
 from ..ops.sampling import model_top_logprobs, sample_logits
 from ..parallel.mesh import DATA_AXIS, auto_mesh
@@ -88,6 +89,8 @@ class LocalEngine:
         use_mesh: bool = True,
         quantize: "bool | str" = False,
         sp_prefill_min_tokens: Optional[int] = None,
+        prefix_cache_size: int = 0,
+        prefix_cache_min_reuse: int = 32,
     ):
         self.config = get_config(config) if isinstance(config, str) else config
         if mesh is None and use_mesh and len(jax.devices()) > 1:
@@ -148,8 +151,23 @@ class LocalEngine:
         # kernel can't express. None disables the route.
         self.sp_prefill_min_tokens = sp_prefill_min_tokens
 
+        # Prompt-prefix KV cache (LRU over full prompts, device-resident).
+        # Repeated-extraction workloads share a long instruction/system
+        # prefix; a new prompt reuses the longest common token prefix of any
+        # cached prompt's KV and prefills only the suffix
+        # (models/llama.py::prefill_continue). 0 disables.
+        self.prefix_cache_size = prefix_cache_size
+        self.prefix_cache_min_reuse = prefix_cache_min_reuse
+        from collections import OrderedDict
+
+        self._prefix_entries: "OrderedDict[Tuple[int, ...], Tuple[Any, KVCache, int]]" = (
+            OrderedDict()
+        )
+        self.prefix_cache_stats = {"hits": 0, "partial_hits": 0, "misses": 0}
+
         self._prefill_cache: Dict[Any, Any] = {}
         self._sp_prefill_cache: Dict[Any, Any] = {}
+        self._continue_cache: Dict[Any, Any] = {}
         self._decode_cache: Dict[Any, Any] = {}
         self._embed_cache: Dict[Any, Any] = {}
 
@@ -238,6 +256,135 @@ class LocalEngine:
             fn = jax.jit(_sp, out_shardings=out_shardings)
             self._sp_prefill_cache[bucket] = fn
         return fn
+
+    # -- prefix cache ------------------------------------------------------
+    def _get_prefill_continue(self, s_bucket: int, total_bucket: int):
+        """Jitted suffix prefill: writes suffix KV into the reused prefix
+        cache at write_index=prefix_len; same output contract as prefill."""
+        key = (s_bucket, total_bucket)
+        fn = self._continue_cache.get(key)
+        if fn is None:
+            def _cont(params, suffix_tokens, cache, prefix_len, total_len):
+                return prefill_continue(
+                    self.config, params, suffix_tokens, cache, prefix_len, total_len
+                )
+
+            if self.mesh is not None:
+                out_shardings = (
+                    NamedSharding(self.mesh, P(None, None)),
+                    KVCache(
+                        k=NamedSharding(self.mesh, cache_specs(shared_prefix=True)),
+                        v=NamedSharding(self.mesh, cache_specs(shared_prefix=True)),
+                    ),
+                )
+                fn = jax.jit(_cont, out_shardings=out_shardings, donate_argnums=(2,))
+            else:
+                fn = jax.jit(_cont, donate_argnums=(2,))
+            self._continue_cache[key] = fn
+        return fn
+
+    def _prefix_store(self, ids: List[int], first_logits, prefix: KVCache) -> None:
+        key = tuple(ids)
+        self._prefix_entries[key] = (
+            first_logits, prefix, len(ids), np.asarray(ids, np.int32)
+        )
+        self._prefix_entries.move_to_end(key)
+        while len(self._prefix_entries) > self.prefix_cache_size:
+            self._prefix_entries.popitem(last=False)
+
+    def _prefix_match(self, ids: List[int]) -> Tuple[Optional[KVCache], int]:
+        """Longest common token prefix across cached prompts (vectorized —
+        long prompts are exactly the cache's target workload). Returns the
+        matched entry's KV and the usable common length (capped below the new
+        prompt's length so there is always >=1 suffix token to prefill)."""
+        ids_np = np.asarray(ids, np.int32)
+        best_kv, best_p = None, 0
+        for _, kv, plen, arr in self._prefix_entries.values():
+            limit = min(len(ids) - 1, plen)
+            neq = np.flatnonzero(arr[:limit] != ids_np[:limit])
+            p = int(neq[0]) if neq.size else limit
+            if p > best_p:
+                best_p, best_kv = p, kv
+        return best_kv, best_p
+
+    # Continuation prefill runs masked XLA attention (the flash kernel needs
+    # write_index=None), whose per-layer f32 score tensor is
+    # [num_heads, s_bucket, cont_bucket]. Cap it at ~1 GB; beyond that a FULL
+    # prefill through the flash/SP path is both safer and faster.
+    MAX_CONT_SCORE_BYTES = 1 << 30
+
+    def _prefill_with_cache(self, prompt_ids: List[int], prompt_len: int, bucket: int):
+        """Prefill through the prompt-prefix cache: exact hit -> zero device
+        work; partial hit past the reuse threshold -> suffix-only prefill;
+        miss -> full (dense or sequence-parallel) prefill. Always stores the
+        resulting full-prompt KV back into the LRU."""
+        config = self.config
+        key = tuple(prompt_ids)
+        hit = self._prefix_entries.get(key)
+        if hit is not None:
+            self._prefix_entries.move_to_end(key)
+            self.prefix_cache_stats["hits"] += 1
+            return hit[0], hit[1]
+
+        matched_kv, p = self._prefix_match(prompt_ids)
+        s_bucket = _bucket(max(1, prompt_len - p), minimum=32)
+        cont_bucket = max(bucket, _bucket(p + s_bucket, minimum=32))
+        continuation_ok = (
+            matched_kv is not None
+            and p >= self.prefix_cache_min_reuse
+            and p + s_bucket <= config.max_seq_len
+            and config.num_heads * s_bucket * cont_bucket * 4
+            <= self.MAX_CONT_SCORE_BYTES
+        )
+        if continuation_ok:
+            self.prefix_cache_stats["partial_hits"] += 1
+            suffix = prompt_ids[p:]
+            suffix_tokens = jnp.array(
+                [suffix + [config.pad_token_id] * (s_bucket - len(suffix))], jnp.int32
+            )
+            # Seed the cache with the reused prefix rows; cont_bucket >= the
+            # full bucketed write at position p because dynamic_update_slice
+            # silently CLAMPS an out-of-bounds start index (which would land
+            # the suffix KV at the wrong rows). The continuation jit donates
+            # this buffer and writes the suffix KV in place.
+            pad = [(0, 0)] * 5
+            pad[2] = (0, cont_bucket - p)
+            cache0 = KVCache(
+                k=jnp.pad(matched_kv.k[:, :, :p], pad),
+                v=jnp.pad(matched_kv.v[:, :, :p], pad),
+            )
+            first_logits, prefix = self._get_prefill_continue(s_bucket, cont_bucket)(
+                self.params, suffix_tokens, cache0,
+                jnp.int32(p), jnp.int32(prompt_len),
+            )
+            if cont_bucket != bucket:
+                prefix = KVCache(
+                    k=prefix.k[:, :, :bucket], v=prefix.v[:, :, :bucket]
+                )
+        else:
+            self.prefix_cache_stats["misses"] += 1
+            first_logits, prefix = self._prefill_full(prompt_ids, prompt_len, bucket)
+        self._prefix_store(prompt_ids, first_logits, prefix)
+        return first_logits, prefix
+
+    def _prefill_full(self, prompt_ids: List[int], prompt_len: int, bucket: int):
+        """One full-prompt prefill: dense, or sequence-parallel when the
+        prompt qualifies (the single dispatch point for generate,
+        generate_many, and the prefix-cache miss path)."""
+        tokens = jnp.array(
+            [prompt_ids + [self.config.pad_token_id] * (bucket - prompt_len)],
+            jnp.int32,
+        )
+        if self._use_sp_prefill(prompt_len, bucket):
+            return self._get_sp_prefill(bucket)(
+                self.params, tokens, jnp.int32(prompt_len)
+            )
+        return self._get_prefill(bucket)(self.params, tokens, jnp.int32(prompt_len))
+
+    def _prefill_routed(self, prompt_ids: List[int], prompt_len: int, bucket: int):
+        if self.prefix_cache_size > 0:
+            return self._prefill_with_cache(prompt_ids, prompt_len, bucket)
+        return self._prefill_full(prompt_ids, prompt_len, bucket)
 
     # -- decode loop ------------------------------------------------------
     def _get_decode_loop(
@@ -529,21 +676,11 @@ class LocalEngine:
 
         self._validate_constraint(constraint, eos)
 
-        tokens = jnp.array(
-            [prompt_ids + [config.pad_token_id] * (bucket - prompt_len)], jnp.int32
-        )
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
         req_keys = jnp.stack([jax.random.key(seed)])
 
-        if self._use_sp_prefill(prompt_len, bucket):
-            first_logits, prefix = self._get_sp_prefill(bucket)(
-                self.params, tokens, jnp.int32(prompt_len)
-            )
-        else:
-            first_logits, prefix = self._get_prefill(bucket)(
-                self.params, tokens, jnp.int32(prompt_len)
-            )
+        first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
         loop = self._get_decode_loop(
             1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
@@ -641,18 +778,10 @@ class LocalEngine:
 
         first_list, k_list, v_list = [], [], []
         for ids, prompt_len, bucket in preps:
-            tokens = jnp.array(
-                [ids + [config.pad_token_id] * (bucket - prompt_len)], jnp.int32
-            )
-            # Per-request SP routing: a coalesced batch of long prompts must
-            # not fall back to dense prefill (the very workload
-            # sp_prefill_min_tokens exists for would OOM there).
-            prefill_fn = (
-                self._get_sp_prefill(bucket)
-                if self._use_sp_prefill(prompt_len, bucket)
-                else self._get_prefill(bucket)
-            )
-            fl, pref = prefill_fn(self.params, tokens, jnp.int32(prompt_len))
+            # Per-request routing: a coalesced batch gets the same SP and
+            # prefix-cache treatment as solo requests — concurrency is
+            # exactly when the repeated-extraction cache workload shows up.
+            fl, pref = self._prefill_routed(ids, prompt_len, bucket)
             if bucket < bucket_max:
                 pad = [(0, 0)] * 5
                 pad[2] = (0, bucket_max - bucket)  # masked by prompt_len anyway

@@ -567,6 +567,57 @@ def prefill(
     return logits, cache
 
 
+def prefill_continue(
+    config: ModelConfig,
+    params: Params,
+    suffix_tokens: jax.Array,
+    cache: KVCache,
+    prefix_len: jax.Array,
+    total_len: jax.Array,
+) -> Tuple[jax.Array, KVCache]:
+    """Prefill a prompt SUFFIX against an already-computed prompt-prefix KV —
+    the prefix-caching path (the reference has no model layer; its provider
+    re-reads the full prompt every request).
+
+    ``cache`` [L, 1, Btot, KVH, D] holds the reused prefix KV at positions
+    0..prefix_len (rest arbitrary); suffix_tokens: [1, Sq] right-padded; the
+    suffix KV is written in place at positions prefix_len.. and the UPDATED
+    cache is returned — directly the decode loop's shared-prefix cache and
+    the next cache entry. Attention masks are built over absolute positions,
+    so sliding windows (static or alternating) and softcaps work unchanged.
+    Returns (last-valid-token logits [1, V], updated KVCache).
+    """
+    B, Sq = suffix_tokens.shape
+    Btot = cache.k.shape[2]
+    positions = prefix_len + jnp.broadcast_to(jnp.arange(Sq)[None, :], (B, Sq))
+    x = _embed(config, params, suffix_tokens)
+
+    rows = prefix_len + jnp.arange(Sq)[None, :, None]  # absolute query positions
+    cols = jnp.arange(Btot)[None, None, :]
+    causal_abs = cols <= rows  # [1, Sq, Btot]
+    key_mask_global = None
+    if config.sliding_window is not None:
+        band = causal_abs & (cols > rows - config.sliding_window)
+        if config.sliding_window_layers == "alternating":
+            key_mask_global = causal_abs
+        causal_abs = band
+    x, cache = _apply_stack(
+        config,
+        params,
+        x,
+        positions,
+        cache,
+        prefix_len,
+        causal_abs,
+        key_mask_global=key_mask_global,
+    )
+    h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
+    last_row = (total_len - prefix_len - 1).reshape(B, 1, 1).astype(jnp.int32)
+    last = jnp.take_along_axis(h, last_row, axis=1)
+    logits = _logits(config, params, last[:, 0, :])
+    return logits, cache
+
+
 def decode_step(
     config: ModelConfig,
     params: Params,
