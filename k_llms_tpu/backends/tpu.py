@@ -64,6 +64,12 @@ class BackendConfig(BaseModel):
     # documents). 0 disables.
     prefix_cache_size: int = 0
     prefix_cache_min_reuse: int = 32
+    # Speculative decoding: "prompt_lookup" drafts tokens from the prompt's
+    # own text and verifies them in one forward — exact sampling at any
+    # temperature; ~2x decode on prompt-copying extraction with real
+    # checkpoints, ~1.4x slower at zero acceptance (see ops/speculative.py).
+    speculative: Optional[str] = None
+    spec_lookahead: int = 4
 
 
 class TpuBackend(Backend):
@@ -120,6 +126,8 @@ class TpuBackend(Backend):
             sp_prefill_min_tokens=cfg.sp_prefill_min_tokens,
             prefix_cache_size=cfg.prefix_cache_size,
             prefix_cache_min_reuse=cfg.prefix_cache_min_reuse,
+            speculative=cfg.speculative,
+            spec_lookahead=cfg.spec_lookahead,
         )
         self.default_max_new_tokens = cfg.max_new_tokens
         # All device work funnels through one scheduler so concurrent clients

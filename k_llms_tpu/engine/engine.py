@@ -39,6 +39,7 @@ from ..models.llama import (
     init_params,
     prefill,
     prefill_continue,
+    verify_step,
 )
 from ..ops.sampling import model_top_logprobs, sample_logits
 from ..parallel.mesh import DATA_AXIS, auto_mesh
@@ -91,6 +92,8 @@ class LocalEngine:
         sp_prefill_min_tokens: Optional[int] = None,
         prefix_cache_size: int = 0,
         prefix_cache_min_reuse: int = 32,
+        speculative: Optional[str] = None,
+        spec_lookahead: int = 4,
     ):
         self.config = get_config(config) if isinstance(config, str) else config
         if mesh is None and use_mesh and len(jax.devices()) > 1:
@@ -160,15 +163,28 @@ class LocalEngine:
         self.prefix_cache_min_reuse = prefix_cache_min_reuse
         from collections import OrderedDict
 
-        self._prefix_entries: "OrderedDict[Tuple[int, ...], Tuple[Any, KVCache, int]]" = (
+        # value: (first_logits, prefix KVCache, prompt_len, np.int32 token ids)
+        self._prefix_entries: "OrderedDict[Tuple[int, ...], Tuple[Any, KVCache, int, Any]]" = (
             OrderedDict()
         )
         self.prefix_cache_stats = {"hits": 0, "partial_hits": 0, "misses": 0}
+
+        # Speculative decoding: "prompt_lookup" drafts the next spec_lookahead
+        # tokens from the prompt's own text and verifies them in one forward
+        # (ops/speculative.py). Opt-in; sampling distribution is exact at any
+        # temperature (sample-and-match acceptance).
+        if speculative not in (None, "prompt_lookup"):
+            raise ValueError(
+                f"Unknown speculative mode {speculative!r}; use 'prompt_lookup'"
+            )
+        self.speculative = speculative
+        self.spec_lookahead = max(1, int(spec_lookahead))
 
         self._prefill_cache: Dict[Any, Any] = {}
         self._sp_prefill_cache: Dict[Any, Any] = {}
         self._continue_cache: Dict[Any, Any] = {}
         self._decode_cache: Dict[Any, Any] = {}
+        self._spec_decode_cache: Dict[Any, Any] = {}
         self._embed_cache: Dict[Any, Any] = {}
 
     # -- sharding helpers -------------------------------------------------
@@ -328,7 +344,13 @@ class LocalEngine:
 
         matched_kv, p = self._prefix_match(prompt_ids)
         s_bucket = _bucket(max(1, prompt_len - p), minimum=32)
-        cont_bucket = max(bucket, _bucket(p + s_bucket, minimum=32))
+        # Power-of-two rounding capped at max_seq_len: no position past the
+        # model's maximum is ever addressable, so rows beyond it would be
+        # pure allocation waste (p + s_bucket <= max_seq_len is guarded
+        # below, so the capped size always fits the write).
+        cont_bucket = max(
+            bucket, min(_bucket(p + s_bucket, minimum=32), config.max_seq_len)
+        )
         continuation_ok = (
             matched_kv is not None
             and p >= self.prefix_cache_min_reuse
@@ -587,6 +609,155 @@ class LocalEngine:
         self._decode_cache[cache_key] = fn
         return fn
 
+    # -- speculative decode loop ------------------------------------------
+    def _get_spec_decode_loop(
+        self,
+        n_per: int,
+        max_new: int,
+        temperature: float,
+        top_p: Optional[float],
+        top_k: Optional[int],
+        bucket: int,
+    ):
+        """Jitted prompt-lookup speculative loop (single request, no mesh).
+
+        State carries per-row buffered-token counts instead of a global step:
+        each iteration drafts K tokens from the prompt, verifies the row's
+        last token + drafts in ONE forward (per-row KV write offsets), samples
+        every position from its own conditional, and emits the longest
+        confirmed run — 1..K+1 tokens per weight-streaming pass.
+        """
+        K = self.spec_lookahead
+        cache_key = ("spec", n_per, max_new, temperature, top_p, top_k, K, bucket)
+        fn = self._spec_decode_cache.get(cache_key)
+        if fn is not None:
+            return fn
+
+        from ..ops.speculative import accept_drafts, propose_prompt_lookup, scatter_rows
+
+        config = self.config
+        pad_id = config.pad_token_id
+        B = n_per
+        BUF = max_new + K + 1
+
+        def _row_keys(req_key, step_id):
+            sk = jax.random.fold_in(req_key, step_id)
+            return jax.vmap(lambda i: jax.random.fold_in(sk, i))(jnp.arange(B))
+
+        def _loop(params, prefix, prompt_tokens, prompt_len, first_logits, req_key, eos_ids):
+            sample = partial(
+                sample_logits, temperature=temperature, top_p=top_p, top_k=top_k
+            )
+            pad_col = jnp.where(jnp.isin(jnp.int32(pad_id), eos_ids), 0.0, -jnp.inf)
+
+            def _mask_pad(lg):
+                return lg.at[:, pad_id].add(pad_col)
+
+            V = first_logits.shape[-1]
+            logits0 = jnp.broadcast_to(first_logits, (B, V))
+            tok0, lp0 = sample(_mask_pad(logits0), None, row_keys=_row_keys(req_key, 0))
+            toks = jnp.full((B, BUF), pad_id, jnp.int32).at[:, 0].set(tok0)
+            lps = jnp.zeros((B, BUF), jnp.float32).at[:, 0].set(lp0)
+            count0 = jnp.ones((B,), jnp.int32)
+            eos0 = jnp.isin(tok0, eos_ids)
+            done0 = eos0 | (count0 >= max_new)
+
+            gen_cache = init_cache(config, B, BUF)
+
+            def cond(state):
+                it, count, done, *_ = state
+                return jnp.logical_and(it < max_new, jnp.logical_not(jnp.all(done)))
+
+            def body(state):
+                it, count, done, hit_eos_any, cache, toks, lps = state
+                cur = jnp.take_along_axis(toks, (count - 1)[:, None], axis=1)[:, 0]
+                prev = jnp.where(
+                    count >= 2,
+                    jnp.take_along_axis(
+                        toks, jnp.maximum(count - 2, 0)[:, None], axis=1
+                    )[:, 0],
+                    prompt_tokens[prompt_len - 1],
+                )
+                drafts = propose_prompt_lookup(
+                    prompt_tokens, prompt_len, prev, cur, K
+                )  # [B, K]
+                block = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, K+1]
+                logits, cache = verify_step(
+                    config, params, block, count - 1,
+                    jnp.asarray([prompt_len], jnp.int32), cache, prefix,
+                )
+                # ONE flattened sampling call for all K+1 positions (a single
+                # top-p bisection instead of K+1 sequential ones). Keys fold
+                # (iteration, position) then row, so every (position, row)
+                # draw is independent and reproducible.
+                V = logits.shape[-1]
+                flat = _mask_pad(logits.reshape(B * (K + 1), V))
+                it_key = jax.random.fold_in(req_key, it)
+                pos_keys = jax.vmap(
+                    lambda j: jax.vmap(
+                        lambda r: jax.random.fold_in(jax.random.fold_in(it_key, j), r)
+                    )(jnp.arange(B))
+                )(jnp.arange(K + 1))  # [K+1, B]
+                flat_keys = jnp.swapaxes(pos_keys, 0, 1).reshape(B * (K + 1))
+                t_flat, lp_flat = sample(flat, None, row_keys=flat_keys)
+                sampled = t_flat.reshape(B, K + 1)
+                lp_arr = lp_flat.reshape(B, K + 1)
+
+                budget = jnp.where(done, 0, max_new - count)
+                emit, counts_new, hit_eos = accept_drafts(
+                    sampled, drafts, eos_ids, budget
+                )
+                toks = scatter_rows(toks, jnp.where(emit, sampled, pad_id), count)
+                lps = scatter_rows(lps, jnp.where(emit, lp_arr, 0.0), count)
+                count = count + counts_new
+                hit_eos_any = hit_eos_any | hit_eos
+                done = done | hit_eos | (count >= max_new)
+                return (it + 1, count, done, hit_eos_any, cache, toks, lps)
+
+            state = (jnp.int32(1), count0, done0, eos0, gen_cache, toks, lps)
+            _, count, _, hit_eos_any, _, toks, lps = lax.while_loop(cond, body, state)
+            return toks[:, :max_new], lps[:, :max_new], hit_eos_any, count
+
+        fn = jax.jit(_loop)
+        self._spec_decode_cache[cache_key] = fn
+        return fn
+
+    def _generate_speculative(
+        self,
+        prompt_ids: List[int],
+        prompt_len: int,
+        bucket: int,
+        n: int,
+        max_new_tokens: int,
+        temperature: float,
+        top_p: Optional[float],
+        top_k: Optional[int],
+        seed: int,
+        eos_arr: jax.Array,
+    ) -> GenerationResult:
+        config = self.config
+        first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
+        prompt_buf = jnp.array(
+            prompt_ids + [config.pad_token_id] * (bucket - prompt_len), jnp.int32
+        )
+        loop = self._get_spec_decode_loop(
+            n, max_new_tokens, temperature, top_p, top_k, bucket
+        )
+        toks, lps, hit_eos, count = loop(
+            self.params, prefix, prompt_buf, jnp.int32(prompt_len),
+            first_logits, jax.random.key(seed), eos_arr,
+        )
+        toks_np, lps_np, eos_np, count_np = map(
+            np.asarray, jax.device_get((toks, lps, hit_eos, count))
+        )
+        return GenerationResult(
+            tokens=toks_np[:n],
+            logprobs=lps_np[:n],
+            lengths=count_np[:n].astype(np.int32),
+            finish_reasons=["stop" if d else "length" for d in eos_np[:n]],
+            prompt_len=prompt_len,
+        )
+
     # -- request prep -----------------------------------------------------
     def _prep_prompt(self, prompt_ids: Sequence[int]) -> Tuple[List[int], int, int]:
         """Normalize a prompt: BOS fallback, left-truncate to max_seq_len, and
@@ -678,6 +849,23 @@ class LocalEngine:
 
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
+
+        # Prompt-lookup speculative decode: single-chip path without the
+        # features the verify loop doesn't model (grammar masks advance one
+        # token at a time; penalties/top_logprobs count per emitted step).
+        if (
+            self.speculative == "prompt_lookup"
+            and self.mesh is None
+            and constraint is None
+            and top_logprobs is None
+            and frequency_penalty == 0.0
+            and presence_penalty == 0.0
+        ):
+            return self._generate_speculative(
+                prompt_ids, prompt_len, bucket, n, max_new_tokens,
+                temperature, top_p, top_k, seed, eos_arr,
+            )
+
         req_keys = jnp.stack([jax.random.key(seed)])
 
         first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)

@@ -251,6 +251,14 @@ def _block(
     if write_index is None:
         cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(cache_k.dtype), 0, axis=1)
         cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(cache_v.dtype), 0, axis=1)
+    elif getattr(write_index, "ndim", 0) == 1:
+        # Per-ROW write offsets (speculative verify: rows have different
+        # generated lengths) — a vmapped dynamic_update_slice per batch row.
+        row_update = jax.vmap(
+            lambda c, kk, off: lax.dynamic_update_slice_in_dim(c, kk, off, axis=0)
+        )
+        cache_k = row_update(cache_k, k.astype(cache_k.dtype), write_index)
+        cache_v = row_update(cache_v, v.astype(cache_v.dtype), write_index)
     else:
         cache_k = lax.dynamic_update_slice_in_dim(
             cache_k, k.astype(cache_k.dtype), write_index, axis=1
@@ -679,4 +687,70 @@ def decode_step(
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     logits = _logits(config, params, h[:, 0, :])
+    return logits, gen_cache
+
+
+def verify_step(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    prompt_len: jax.Array,
+    gen_cache: KVCache,
+    prefix: KVCache,
+) -> Tuple[jax.Array, KVCache]:
+    """Speculative-decoding verification: score k+1 tokens per row in ONE
+    forward (the draft-tree trunk of prompt-lookup decoding).
+
+    tokens: [B, Sq] — row b's last accepted token followed by its drafts;
+    lengths: [B] per-row generated-token counts (the write offset into the
+    row's gen cache slots); prompt_len: scalar or [R] as in decode_step.
+    KVs for all Sq positions are written at per-row offsets; acceptance-
+    rejected slots simply get overwritten by a later verify. Returns
+    (logits f32 [B, Sq, V] — logits[b, j] conditions on tokens[b, :j+1] —
+    and the updated gen_cache).
+    """
+    B, Sq = tokens.shape
+    G = gen_cache.max_len
+    P = prefix.max_len
+
+    pl = jnp.asarray(prompt_len, jnp.int32).reshape(-1)
+    pl_row = jnp.repeat(pl, B // pl.shape[0], total_repeat_length=B)  # [B]
+    lengths = lengths.astype(jnp.int32)
+
+    j = jnp.arange(Sq)[None, :]  # query index within the verify block
+    positions = pl_row[:, None] + lengths[:, None] + j  # [B, Sq]
+    x = _embed(config, params, tokens)
+
+    # Gen slot s holds the row's s-th generated token: query j sees slots
+    # <= lengths + j (its own freshly written slot included, like decode).
+    s = jnp.arange(G)[None, None, :]
+    self_mask = s <= (lengths[:, None] + j)[:, :, None]  # [B, Sq, G]
+    c = jnp.arange(P)[None, None, :]
+    prefix_mask = (c < pl_row[:, None, None]) & jnp.ones((B, Sq, 1), bool)
+    self_mask_global = prefix_mask_global = None
+    if config.sliding_window is not None:
+        W = config.sliding_window
+        if config.sliding_window_layers == "alternating":
+            self_mask_global, prefix_mask_global = self_mask, prefix_mask
+        qpos_gen = (lengths[:, None] + j)[:, :, None]  # query's gen position
+        self_mask = self_mask & (s > qpos_gen - W)
+        prefix_mask = prefix_mask & (c > positions[:, :, None] - W)
+
+    x, gen_cache = _apply_stack(
+        config,
+        params,
+        x,
+        positions,
+        gen_cache,
+        lengths,
+        self_mask,
+        prefix=prefix,
+        prefix_mask=prefix_mask,
+        key_mask_global=self_mask_global,
+        prefix_mask_global=prefix_mask_global,
+        prefix_lengths=pl,
+    )
+    h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
+    logits = _logits(config, params, h)
     return logits, gen_cache
