@@ -679,7 +679,8 @@ class LocalEngine:
                     prompt_tokens[prompt_len - 1],
                 )
                 drafts = propose_prompt_lookup(
-                    prompt_tokens, prompt_len, prev, cur, K
+                    prompt_tokens, prompt_len, prev, cur, K,
+                    gen=toks, gen_len=count,
                 )  # [B, K]
                 block = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, K+1]
                 logits, cache = verify_step(
@@ -747,14 +748,19 @@ class LocalEngine:
             self.params, prefix, prompt_buf, jnp.int32(prompt_len),
             first_logits, jax.random.key(seed), eos_arr,
         )
-        toks_np, lps_np, eos_np, count_np = map(
-            np.asarray, jax.device_get((toks, lps, hit_eos, count))
+        toks_np, lps_np, eos_np = map(
+            np.asarray, jax.device_get((toks, lps, hit_eos))
         )
+        toks_np, lps_np, eos_np = toks_np[:n], lps_np[:n], eos_np[:n]
+        # Same length convention as the normal loop: count non-pad tokens, so
+        # a pad-mapped-to-eos stop token is excluded identically in both modes
+        # (emitted tokens are otherwise never pad — pad is masked at sampling).
+        lengths = (toks_np != config.pad_token_id).sum(axis=1).astype(np.int32)
         return GenerationResult(
-            tokens=toks_np[:n],
-            logprobs=lps_np[:n],
-            lengths=count_np[:n].astype(np.int32),
-            finish_reasons=["stop" if d else "length" for d in eos_np[:n]],
+            tokens=toks_np,
+            logprobs=lps_np,
+            lengths=lengths,
+            finish_reasons=["stop" if d else "length" for d in eos_np],
             prompt_len=prompt_len,
         )
 

@@ -42,25 +42,49 @@ def propose_prompt_lookup(
     prev: jax.Array,
     cur: jax.Array,
     k: int,
+    gen: "jax.Array | None" = None,
+    gen_len: "jax.Array | None" = None,
 ) -> jax.Array:
-    """Per-row drafts from the prompt. prompt: [S] token buffer (padded);
-    prompt_len: scalar valid length; prev/cur: [B] the row's trailing bigram.
+    """Per-row drafts from the prompt and (optionally) the row's own generated
+    text. prompt: [S] token buffer (padded); prompt_len: scalar valid length;
+    prev/cur: [B] the row's trailing bigram; gen: [B, T] generated-token
+    buffers with valid lengths gen_len [B].
+
     Returns drafts [B, k] — the k tokens following the LAST occurrence of
-    (prev, cur) inside the prompt; rows without a match (or positions past
-    the prompt end) fall back to repeating ``cur`` (harmless: the verify
-    sampler just won't match them).
+    (prev, cur), preferring a match in the row's generated text (the more
+    recent context; models repeating their own phrasing) over one in the
+    prompt. Rows without a match, or draft positions past the source's end,
+    fall back to repeating ``cur`` (harmless: the verify sampler just won't
+    match them).
     """
     S = prompt.shape[0]
     pos = jnp.arange(1, S)
 
-    def one_row(a, b):
+    def from_prompt(a, b):
         hit = (prompt[:-1] == a) & (prompt[1:] == b) & (pos < prompt_len)
         last = jnp.max(jnp.where(hit, pos, -1))  # index of the bigram's 2nd token
         idx = last + 1 + jnp.arange(k)
         ok = (last >= 0) & (idx < prompt_len)
         return jnp.where(ok, prompt[jnp.clip(idx, 0, S - 1)], b).astype(jnp.int32)
 
-    return jax.vmap(one_row)(prev, cur)
+    drafts = jax.vmap(from_prompt)(prev, cur)
+    if gen is None:
+        return drafts
+
+    T = gen.shape[1]
+    gpos = jnp.arange(1, T)
+
+    def from_gen(row, glen, a, b):
+        # Exclude the row's TRAILING bigram itself (position glen-1): matching
+        # it is vacuous and its continuation lies past the generated text.
+        hit = (row[:-1] == a) & (row[1:] == b) & (gpos < glen - 1)
+        last = jnp.max(jnp.where(hit, gpos, -1))
+        idx = last + 1 + jnp.arange(k)
+        ok = (last >= 0) & (idx < glen)
+        return last >= 0, jnp.where(ok, row[jnp.clip(idx, 0, T - 1)], b).astype(jnp.int32)
+
+    has_gen, gen_drafts = jax.vmap(from_gen)(gen, gen_len, prev, cur)
+    return jnp.where(has_gen[:, None], gen_drafts, drafts)
 
 
 def accept_drafts(

@@ -134,13 +134,18 @@ def test_spec_sampling_outputs_valid(engines):
 
 
 def test_spec_respects_eos(engines):
-    """Rows that emit eos finish with reason "stop" and stop growing."""
-    _, spec = engines
-    # eos on a very likely token id range: use all token ids as eos to force
-    # an immediate stop.
+    """Rows that emit eos finish with reason "stop" and stop growing — forced
+    by declaring the greedy chain's own first token to be eos."""
+    normal, spec = engines
+    first = int(
+        normal.generate(PROMPT, n=1, max_new_tokens=1, temperature=0.0, seed=3).tokens[0, 0]
+    )
     r = spec.generate(PROMPT, n=2, max_new_tokens=8, temperature=0.0, seed=3,
-                      eos_ids=list(range(0, 4)))
-    assert r.tokens.shape == (2, 8)
+                      eos_ids=[first])
+    assert r.finish_reasons == ["stop", "stop"]
+    np.testing.assert_array_equal(r.lengths, [1, 1])
+    assert (r.tokens[:, 0] == first).all()
+    assert (r.tokens[:, 1:] == spec.config.pad_token_id).all()
 
 
 def test_spec_falls_back_for_unsupported_features(engines):
@@ -181,3 +186,25 @@ def test_spec_loop_runs_through_engine_generate():
     )
     eng.generate([5, 6, 7, 8], n=2, max_new_tokens=4, temperature=0.7, seed=1)
     assert eng._spec_decode_cache and not eng._decode_cache
+
+
+def test_propose_prefers_generated_text_match():
+    prompt = jnp.array([5, 6, 30, 0, 0], jnp.int32)
+    gen = jnp.array([[9, 5, 6, 40, 41, 5, 6, 0]], jnp.int32)
+    drafts = propose_prompt_lookup(
+        prompt, jnp.int32(3), jnp.array([5]), jnp.array([6]), k=2,
+        gen=gen, gen_len=jnp.array([7]),
+    )
+    # Trailing bigram (5,6) at positions 5,6 is excluded; the match at 1,2
+    # gives continuation 40,41 — preferred over the prompt's 30.
+    np.testing.assert_array_equal(np.asarray(drafts), [[40, 41]])
+
+
+def test_propose_gen_without_match_falls_back_to_prompt():
+    prompt = jnp.array([5, 6, 30, 31, 0], jnp.int32)
+    gen = jnp.array([[1, 2, 3, 5, 6, 0, 0, 0]], jnp.int32)  # only trailing bigram
+    drafts = propose_prompt_lookup(
+        prompt, jnp.int32(4), jnp.array([5]), jnp.array([6]), k=2,
+        gen=gen, gen_len=jnp.array([5]),
+    )
+    np.testing.assert_array_equal(np.asarray(drafts), [[30, 31]])
