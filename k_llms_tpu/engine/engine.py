@@ -179,6 +179,9 @@ class LocalEngine:
             )
         self.speculative = speculative
         self.spec_lookahead = max(1, int(spec_lookahead))
+        # Last speculative request's acceptance stats (verify_iterations,
+        # tokens_per_iteration) — the knob users tune spec_lookahead against.
+        self.spec_stats: Dict[str, Any] = {}
 
         self._prefill_cache: Dict[Any, Any] = {}
         self._sp_prefill_cache: Dict[Any, Any] = {}
@@ -669,7 +672,8 @@ class LocalEngine:
                 return jnp.logical_and(it < max_new, jnp.logical_not(jnp.all(done)))
 
             def body(state):
-                it, count, done, hit_eos_any, cache, toks, lps = state
+                it, count, done, hit_eos_any, row_iters, cache, toks, lps = state
+                row_iters = row_iters + jnp.where(done, 0, 1)  # verifies entered
                 cur = jnp.take_along_axis(toks, (count - 1)[:, None], axis=1)[:, 0]
                 prev = jnp.where(
                     count >= 2,
@@ -713,11 +717,16 @@ class LocalEngine:
                 count = count + counts_new
                 hit_eos_any = hit_eos_any | hit_eos
                 done = done | hit_eos | (count >= max_new)
-                return (it + 1, count, done, hit_eos_any, cache, toks, lps)
+                return (it + 1, count, done, hit_eos_any, row_iters, cache, toks, lps)
 
-            state = (jnp.int32(1), count0, done0, eos0, gen_cache, toks, lps)
-            _, count, _, hit_eos_any, _, toks, lps = lax.while_loop(cond, body, state)
-            return toks[:, :max_new], lps[:, :max_new], hit_eos_any, count
+            state = (
+                jnp.int32(1), count0, done0, eos0,
+                jnp.zeros((B,), jnp.int32), gen_cache, toks, lps,
+            )
+            _, count, _, hit_eos_any, row_iters, _, toks, lps = lax.while_loop(
+                cond, body, state
+            )
+            return toks[:, :max_new], lps[:, :max_new], hit_eos_any, count, row_iters
 
         fn = jax.jit(_loop)
         self._spec_decode_cache[cache_key] = fn
@@ -744,14 +753,27 @@ class LocalEngine:
         loop = self._get_spec_decode_loop(
             n, max_new_tokens, temperature, top_p, top_k, bucket
         )
-        toks, lps, hit_eos, count = loop(
+        toks, lps, hit_eos, count, row_iters = loop(
             self.params, prefix, prompt_buf, jnp.int32(prompt_len),
             first_logits, jax.random.key(seed), eos_arr,
         )
-        toks_np, lps_np, eos_np = map(
-            np.asarray, jax.device_get((toks, lps, hit_eos))
+        toks_np, lps_np, eos_np, count_np, iters_np = map(
+            np.asarray, jax.device_get((toks, lps, hit_eos, count, row_iters))
         )
         toks_np, lps_np, eos_np = toks_np[:n], lps_np[:n], eos_np[:n]
+        # Acceptance observability, PER ROW (rows stop at different times):
+        # tokens each row emitted per verify it entered. 1.0 = no draft ever
+        # accepted; > 1 is the speculative win users tune spec_lookahead
+        # against. The first token comes from prefill logits, not a verify.
+        ri = iters_np[:n]
+        rates = (count_np[:n] - 1.0) / np.maximum(ri, 1)
+        ran = ri > 0
+        self.spec_stats = {
+            "verify_iterations": int(ri.max(initial=0)),
+            "tokens_per_iteration": (
+                round(float(rates[ran].mean()), 3) if ran.any() else None
+            ),
+        }
         # Same length convention as the normal loop: count non-pad tokens, so
         # a pad-mapped-to-eos stop token is excluded identically in both modes
         # (emitted tokens are otherwise never pad — pad is masked at sampling).

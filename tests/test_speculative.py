@@ -208,3 +208,25 @@ def test_propose_gen_without_match_falls_back_to_prompt():
         gen=gen, gen_len=jnp.array([5]),
     )
     np.testing.assert_array_equal(np.asarray(drafts), [[30, 31]])
+
+
+def test_spec_stats_reports_acceptance():
+    cfg = get_config("tiny")
+    eng = LocalEngine(
+        cfg, params=init_params(cfg, jax.random.key(0)), use_mesh=False,
+        speculative="prompt_lookup", spec_lookahead=4,
+    )
+    r = eng.generate(PROMPT, n=2, max_new_tokens=10, temperature=0.0, seed=4)
+    stats = eng.spec_stats
+    assert stats["verify_iterations"] >= 1
+    # Per-row rate: each verify a row enters emits at least one token for it;
+    # accepts can only raise the rate.
+    assert stats["tokens_per_iteration"] >= 0.99
+    assert stats["tokens_per_iteration"] <= eng.spec_lookahead + 1
+
+    # Zero-verify edge: every row stops on its prefill-sampled first token.
+    first = int(r.tokens[0, 0])
+    eng.generate(PROMPT, n=2, max_new_tokens=8, temperature=0.0, seed=4,
+                 eos_ids=[first])
+    assert eng.spec_stats["verify_iterations"] == 0
+    assert eng.spec_stats["tokens_per_iteration"] is None
