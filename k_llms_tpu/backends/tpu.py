@@ -57,6 +57,8 @@ class BackendConfig(BaseModel):
     # over the mesh's data axis, O(S/P) activation memory per device) instead
     # of dense. None disables; requires a multi-device mesh.
     sp_prefill_min_tokens: Optional[int] = None
+    # Context-parallel attention for SP prefill: "ring" | "ulysses".
+    sp_attention: str = "ring"
     # Prompt-prefix KV cache: keep the last N full-prompt KV caches on device
     # and reuse the longest common token prefix (>= prefix_cache_min_reuse
     # tokens) of any of them, prefilling only the suffix. Serves the
@@ -124,6 +126,7 @@ class TpuBackend(Backend):
             param_seed=cfg.param_seed,
             quantize=cfg.quantization or False,
             sp_prefill_min_tokens=cfg.sp_prefill_min_tokens,
+            sp_attention=cfg.sp_attention,
             prefix_cache_size=cfg.prefix_cache_size,
             prefix_cache_min_reuse=cfg.prefix_cache_min_reuse,
             speculative=cfg.speculative,

@@ -90,6 +90,7 @@ class LocalEngine:
         use_mesh: bool = True,
         quantize: "bool | str" = False,
         sp_prefill_min_tokens: Optional[int] = None,
+        sp_attention: str = "ring",
         prefix_cache_size: int = 0,
         prefix_cache_min_reuse: int = 32,
         speculative: Optional[str] = None,
@@ -153,6 +154,15 @@ class LocalEngine:
         # and the config's attention has no score-level features the ring
         # kernel can't express. None disables the route.
         self.sp_prefill_min_tokens = sp_prefill_min_tokens
+        # Context-parallel attention strategy for the SP prefill: "ring"
+        # (O(S/P) memory, P-1 hops) or "ulysses" (all-to-all head resharding).
+        # Validated eagerly — a typo must fail at construction, not on the
+        # first long prompt hours into serving.
+        if sp_attention not in ("ring", "ulysses"):
+            raise ValueError(
+                f"Unknown sp_attention {sp_attention!r}; use 'ring' or 'ulysses'"
+            )
+        self.sp_attention = sp_attention
 
         # Prompt-prefix KV cache (LRU over full prompts, device-resident).
         # Repeated-extraction workloads share a long instruction/system
@@ -260,7 +270,8 @@ class LocalEngine:
                 # whole sequence would dwarf the O(S/P) memory budget this
                 # path exists for.
                 _, h, kv = forward_sequence_parallel(
-                    config, params, tokens, mesh, seq_axis=DATA_AXIS
+                    config, params, tokens, mesh,
+                    seq_axis=DATA_AXIS, attention=self.sp_attention,
                 )
                 h_last = lax.dynamic_slice_in_dim(h, prompt_len - 1, 1, axis=1)
                 return _logits(config, params, h_last)[:, 0, :], kv
@@ -877,6 +888,10 @@ class LocalEngine:
 
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
+
+        # Stats describe THIS request only — a fallback to the normal loop
+        # must not leave a previous speculative request's numbers visible.
+        self.spec_stats = {}
 
         # Prompt-lookup speculative decode: single-chip path without the
         # features the verify loop doesn't model (grammar masks advance one

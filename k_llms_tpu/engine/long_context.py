@@ -51,6 +51,7 @@ def forward_sequence_parallel(
     tokens: jax.Array,
     mesh: Mesh,
     seq_axis: str = "data",
+    attention: str = "ring",
 ) -> Tuple[jax.Array, jax.Array, "KVCache"]:
     """Full causal forward with the sequence sharded over ``seq_axis``.
 
@@ -58,11 +59,25 @@ def forward_sequence_parallel(
     [B, S, V], final hidden [B, S, H], per-layer KVCache [L, B, S, KVH, D]) —
     all sequence-sharded. The KVCache has the exact layout of the dense
     ``prefill``'s prefix cache, so the decode loop consumes it unchanged.
+
+    ``attention`` picks the context-parallel strategy:
+    - "ring": K/V chunks rotate the mesh ring via ppermute with online-softmax
+      accumulation (O(S/P) attention memory per device; P-1 small hops).
+    - "ulysses": DeepSpeed-Ulysses-style all-to-all — activations reshard from
+      sequence-sharded to HEAD-sharded for the attention (each device sees its
+      heads' full sequence), then back. Expressed as GSPMD sharding
+      constraints, so XLA inserts the all-to-alls: two big collectives per
+      layer instead of P-1 hops (wins when the interconnect favors few large
+      transfers), at O(S) attention memory per device.
+    Both are exact; outputs are identical up to float reduction order.
     """
+    if attention not in ("ring", "ulysses"):
+        raise ValueError(f"Unknown sequence-parallel attention {attention!r}")
     if config.attn_softcap is not None or config.sliding_window is not None:
         raise NotImplementedError(
-            "ring attention cannot apply per-score softcap or sliding windows; "
-            f"config {config.name!r} must use the dense prefill path"
+            "sequence-parallel attention cannot apply per-score softcap or "
+            f"sliding windows; config {config.name!r} must use the dense "
+            "prefill path"
         )
     B, S = tokens.shape
     ring = mesh.shape[seq_axis]
@@ -92,15 +107,40 @@ def forward_sequence_parallel(
         cache_k = lax.with_sharding_constraint(k.astype(config.jax_dtype), kv_sharded)
         cache_v = lax.with_sharding_constraint(v.astype(config.jax_dtype), kv_sharded)
 
-        attn = ring_attention(
-            mesh,
-            q.transpose(0, 2, 1, 3),
-            k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3),
-            seq_axis=seq_axis,
-            causal=True,
-            sm_scale=config.query_scale,
-        ).transpose(0, 2, 1, 3)
+        if attention == "ulysses":
+            # All-to-all context parallelism via GSPMD resharding: [B, H, S, D]
+            # goes from S-sharded to H-sharded (each device now holds its
+            # heads' FULL sequence), attention runs locally, and the output
+            # reshards back — XLA lowers the two constraint flips to
+            # all-to-all collectives over the mesh axis. The attention itself
+            # is the flash kernel (VMEM-tiled online softmax — the [Sq, Sk]
+            # score matrix is never materialized), same as the dense prefill,
+            # so per-device attention memory is the K/V themselves, not S^2.
+            from ..ops.attention import flash_attention
+
+            head_sharded = NamedSharding(mesh, P(None, seq_axis, None, None))
+            qh = lax.with_sharding_constraint(q.transpose(0, 2, 1, 3), head_sharded)
+            kh = lax.with_sharding_constraint(k.transpose(0, 2, 1, 3), head_sharded)
+            vh = lax.with_sharding_constraint(v.transpose(0, 2, 1, 3), head_sharded)
+            attn = flash_attention(
+                qh, kh, vh,
+                causal=True,
+                sm_scale=config.query_scale,
+                interpret=jax.default_backend() != "tpu",
+            )
+            attn = lax.with_sharding_constraint(
+                attn, NamedSharding(mesh, P(None, None, seq_axis, None))
+            ).transpose(0, 2, 1, 3)
+        else:
+            attn = ring_attention(
+                mesh,
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                seq_axis=seq_axis,
+                causal=True,
+                sm_scale=config.query_scale,
+            ).transpose(0, 2, 1, 3)
         attn = attn.astype(x.dtype).reshape(B, S, config.q_dim)
         out = qdot(attn, layer["wo"])
         if "post_attn_norm" in layer:
