@@ -24,8 +24,13 @@ class OpenAIBackend(Backend):
         timeout: Optional[float] = None,
         max_retries: int = 2,
         embedding_model: str = "text-embedding-3-small",
+        model: Optional[str] = None,
         **kwargs: Any,
     ):
+        # ``model`` is accepted for constructor symmetry with the local
+        # backends (the client injects it); the remote API takes the model
+        # per-request, so it is only recorded here.
+        self.model_name = model
         try:
             from openai import OpenAI  # type: ignore
         except ImportError as e:  # pragma: no cover

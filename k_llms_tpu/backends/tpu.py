@@ -77,13 +77,21 @@ class BackendConfig(BaseModel):
 class TpuBackend(Backend):
     def __init__(
         self,
-        model: str = "tiny",
+        model: Optional[str] = None,
         config: Optional[BackendConfig] = None,
         mesh=None,
         engine: Optional[LocalEngine] = None,
         **kwargs: Any,
     ):
-        cfg = config or BackendConfig(model=model, **{
+        if config is not None and model is not None and model != config.model:
+            # An explicit config wins over kwargs — but silently dropping a
+            # CONFLICTING model would load one model's weights while labeling
+            # outputs with the other's name.
+            raise ValueError(
+                f"model={model!r} conflicts with config.model={config.model!r}; "
+                "pass one or make them agree"
+            )
+        cfg = config or BackendConfig(model=model or "tiny", **{
             k: v for k, v in kwargs.items() if k in BackendConfig.model_fields
         })
         self.backend_config = cfg

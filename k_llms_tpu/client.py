@@ -86,8 +86,18 @@ class _BaseKLLMs:
         model: Optional[str] = None,
         **backend_kwargs: Any,
     ):
+        # When WE construct the backend from a name, the client-level model
+        # must reach it: a local backend loads that model's weights at
+        # construction. (Silently building the default model and labeling its
+        # outputs with the requested name would serve the wrong weights.)
+        if not isinstance(backend, Backend) and model is not None:
+            backend_kwargs.setdefault("model", model)
         self._backend = resolve_backend(backend, **backend_kwargs)
-        self.default_model = model or "llama-3-8b"
+        # Default request label follows the weights actually loaded — with no
+        # explicit model, a local backend's own default must not be relabeled.
+        self.default_model = (
+            model or getattr(self._backend, "model_name", None) or "llama-3-8b"
+        )
 
     @property
     def backend(self) -> Backend:

@@ -67,6 +67,19 @@ def _build_request(
         # the jitted decode loop, and fails here as a parameter error instead
         # of an opaque trace error inside top_k.
         raise ValueError(f"top_logprobs must be in 0..20, got {top_logprobs}")
+    # Parameter validation with OpenAI's documented bounds (the reference
+    # delegates these 400s to the server; a local engine must 400 them itself
+    # rather than generate garbage or crash mid-trace).
+    if not messages:
+        raise ValueError("messages must be a non-empty list")
+    if n is not None and n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    if max_tokens is not None and max_tokens < 1:
+        raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+    if temperature is not None and not 0.0 <= temperature <= 2.0:
+        raise ValueError(f"temperature must be in [0, 2], got {temperature}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     return ChatRequest(
         logprobs=logprobs,
         top_logprobs=top_logprobs,

@@ -1,0 +1,94 @@
+"""Parameter validation at the public API boundary — the reference delegates
+these 400s to the OpenAI server (README_TESTS.md error-scenario checklist:
+invalid model, empty messages, bad parameters); a local engine must reject
+them itself with clean errors instead of generating garbage or crashing
+mid-trace."""
+
+import pytest
+
+from k_llms_tpu import KLLMs
+
+
+@pytest.fixture(scope="module")
+def client():
+    return KLLMs(backend="tpu", model="tiny")
+
+
+MSGS = [{"role": "user", "content": "hello"}]
+
+
+def test_empty_messages_rejected(client):
+    with pytest.raises(ValueError, match="messages"):
+        client.chat.completions.create(messages=[], model="tiny", n=2)
+
+
+def test_invalid_model_name_raises_at_construction():
+    with pytest.raises(KeyError):
+        KLLMs(backend="tpu", model="no-such-model")
+
+
+def test_client_model_reaches_backend():
+    """KLLMs(backend="tpu", model=X) must BUILD model X, not the default
+    labeled as X."""
+    c = KLLMs(backend="tpu", model="tiny")
+    assert c.backend.model_name == "tiny"
+
+
+def test_n_zero_rejected(client):
+    with pytest.raises(ValueError, match="n must be"):
+        client.chat.completions.create(messages=MSGS, model="tiny", n=0)
+
+
+def test_negative_max_tokens_rejected(client):
+    with pytest.raises(ValueError, match="max_tokens"):
+        client.chat.completions.create(messages=MSGS, model="tiny", n=1, max_tokens=-5)
+
+
+def test_temperature_out_of_range_rejected(client):
+    for bad in (-1.0, 2.5):
+        with pytest.raises(ValueError, match="temperature"):
+            client.chat.completions.create(
+                messages=MSGS, model="tiny", n=1, temperature=bad
+            )
+
+
+def test_top_p_out_of_range_rejected(client):
+    for bad in (0.0, 1.5, -0.2):
+        with pytest.raises(ValueError, match="top_p"):
+            client.chat.completions.create(messages=MSGS, model="tiny", n=1, top_p=bad)
+
+
+def test_valid_edges_still_serve(client):
+    r = client.chat.completions.create(
+        messages=MSGS, model="tiny", n=1, temperature=0.0, top_p=1.0,
+        max_tokens=1, seed=1,
+    )
+    # n=1 is the reference's single-choice passthrough (no consensus row).
+    assert len(r.choices) == 1
+
+
+def test_parse_validates_too(client):
+    from pydantic import BaseModel
+
+    class Out(BaseModel):
+        x: int
+
+    with pytest.raises(ValueError, match="messages"):
+        client.chat.completions.parse(messages=[], model="tiny", response_format=Out)
+
+
+def test_default_model_label_follows_backend_weights():
+    """KLLMs(backend="tpu") with no model must label requests with the
+    backend's ACTUAL model, not an unrelated default name."""
+    c = KLLMs(backend="tpu")
+    assert c.default_model == c.backend.model_name == "tiny"
+
+
+def test_conflicting_config_and_model_rejected():
+    from k_llms_tpu.backends.tpu import BackendConfig, TpuBackend
+
+    with pytest.raises(ValueError, match="conflicts"):
+        TpuBackend(model="llama-3-8b", config=BackendConfig(model="tiny"))
+    # Agreeing values are fine.
+    b = TpuBackend(model="tiny", config=BackendConfig(model="tiny"))
+    assert b.model_name == "tiny"
