@@ -31,6 +31,10 @@ class ChatRequest:
     response_format: Optional[Any] = None
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None
+    # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to the logits
+    # at sampling time (the reference forwards it to the server; the local
+    # engine applies it in the decode loop).
+    logit_bias: Optional[Dict[str, float]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

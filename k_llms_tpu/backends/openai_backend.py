@@ -65,6 +65,7 @@ class OpenAIBackend(Backend):
             "stop",
             "seed",
             "response_format",
+            "logit_bias",
         ):
             val = getattr(request, name)
             if val is not None:

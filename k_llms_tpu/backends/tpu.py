@@ -166,6 +166,15 @@ class TpuBackend(Backend):
         constraint = self._constraint_for(request.response_format)
         # OpenAI semantics: top_logprobs only applies when logprobs is on.
         top_lp = request.top_logprobs if request.logprobs else None
+        logit_bias = None
+        if request.logit_bias:
+            V = self.engine.config.vocab_size
+            logit_bias = {}
+            for tok_id, bias in request.logit_bias.items():
+                t = int(tok_id)
+                if not 0 <= t < V:
+                    raise ValueError(f"logit_bias token id {t} outside vocab (0..{V-1})")
+                logit_bias[t] = float(bias)
         result = self._generate_batched(
             prompt_ids,
             n=n,
@@ -177,6 +186,7 @@ class TpuBackend(Backend):
             top_logprobs=top_lp,
             frequency_penalty=float(request.frequency_penalty or 0.0),
             presence_penalty=float(request.presence_penalty or 0.0),
+            logit_bias=logit_bias,
         )
 
         stop_strings: List[str] = []
@@ -275,6 +285,7 @@ class TpuBackend(Backend):
         top_logprobs: Optional[int] = None,
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
+        logit_bias: Optional[Dict[int, float]] = None,
     ):
         """Submit one generation through the coalescing scheduler: concurrent
         requests with the same sampling config decode as ONE batched XLA
@@ -289,9 +300,12 @@ class TpuBackend(Backend):
                 else (type(constraint).__name__, constraint.digest)
             )
         eos_ids = self.tokenizer.stop_ids
+        # The bias CONTENT is part of the compatibility key — coalesced rows
+        # share one bias vector, so only identical biases may fuse.
+        bias_key = tuple(sorted(logit_bias.items())) if logit_bias else None
         batch_key = (
             max_new, temperature, top_p, ckey, tuple(eos_ids), top_logprobs,
-            frequency_penalty, presence_penalty,
+            frequency_penalty, presence_penalty, bias_key,
         )
 
         def run(specs):
@@ -305,6 +319,7 @@ class TpuBackend(Backend):
                 top_logprobs=top_logprobs,
                 frequency_penalty=frequency_penalty,
                 presence_penalty=presence_penalty,
+                logit_bias=logit_bias,
             )
 
         # Weight = this request's padded row count (the engine rounds n up to a

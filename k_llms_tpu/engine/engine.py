@@ -435,6 +435,7 @@ class LocalEngine:
         top_logprobs: Optional[int] = None,
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
+        use_logit_bias: bool = False,
     ):
         """Jitted decode loop for R requests × n_per samples each (R=1 is the
         single-request case; R>1 is the cross-request coalesced batch).
@@ -455,7 +456,7 @@ class LocalEngine:
             constraint_key = ("schema", constraint.digest)
         cache_key = (
             num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
-            top_logprobs, frequency_penalty, presence_penalty,
+            top_logprobs, frequency_penalty, presence_penalty, use_logit_bias,
         )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
@@ -505,7 +506,11 @@ class LocalEngine:
             )(step_keys)
             return rk.reshape(B)
 
-        def _loop(params, prefix: KVCache, prompt_lens, first_logits, req_keys, eos_ids):
+        def _loop(params, prefix: KVCache, prompt_lens, first_logits, req_keys, eos_ids, bias):
+            # ``bias`` [V] f32 (zeros when use_logit_bias is False — a dead
+            # arg then, kept so the signature is uniform): OpenAI logit_bias,
+            # applied via the penalty mechanism so reported logprobs stay the
+            # unbiased model distribution's.
             gen_cache = init_cache(config, B, max_new)
             gen_cache = KVCache(
                 k=self._constraint(gen_cache.k, cache_specs()),
@@ -535,7 +540,12 @@ class LocalEngine:
             if jstate is not None:
                 logits0 = mask_logits(jt, logits0, *jstate, eos_ids)
             logits0 = _mask_pad(logits0)
-            tok0, lp0 = sample(logits0, None, row_keys=_row_keys(req_keys, jnp.int32(0)))
+            tok0, lp0 = sample(
+                logits0,
+                None,
+                row_keys=_row_keys(req_keys, jnp.int32(0)),
+                penalty=-bias[None, :] if use_logit_bias else None,
+            )
             tok0 = self._constraint(tok0, batch_spec())
             if jstate is not None:
                 jstate = advance(jt, tok0, *jstate)
@@ -567,11 +577,14 @@ class LocalEngine:
                 counts0 = counts0.at[jnp.arange(B), tok0].add(1.0)
 
             def _penalty(counts):
-                if not penalized:
-                    return None
-                return frequency_penalty * counts + presence_penalty * (
-                    counts > 0
-                ).astype(jnp.float32)
+                pen = None
+                if penalized:
+                    pen = frequency_penalty * counts + presence_penalty * (
+                        counts > 0
+                    ).astype(jnp.float32)
+                if use_logit_bias:  # penalty is SUBTRACTED; bias adds
+                    pen = -bias[None, :] if pen is None else pen - bias[None, :]
+                return pen
 
             def cond(state):
                 step, cur, done, *_ = state
@@ -797,6 +810,22 @@ class LocalEngine:
             prompt_len=prompt_len,
         )
 
+    def _bias_array(self, logit_bias: Optional[Dict[int, float]]) -> jax.Array:
+        """Dense [V] f32 logit-bias vector (zeros when unset — the loop arg is
+        uniform either way; dead when the compiled loop ignores it). The
+        zeros vector is built once and reused: the no-bias hot path must not
+        pay a vocab-sized host allocation + transfer per request."""
+        if not logit_bias:
+            cached = getattr(self, "_zero_bias", None)
+            if cached is None:
+                cached = jnp.zeros((self.config.vocab_size,), jnp.float32)
+                self._zero_bias = cached
+            return cached
+        v = np.zeros((self.config.vocab_size,), np.float32)
+        for tok, bias in logit_bias.items():
+            v[int(tok)] = float(bias)
+        return jnp.asarray(v)
+
     # -- request prep -----------------------------------------------------
     def _prep_prompt(self, prompt_ids: Sequence[int]) -> Tuple[List[int], int, int]:
         """Normalize a prompt: BOS fallback, left-truncate to max_seq_len, and
@@ -873,6 +902,7 @@ class LocalEngine:
         top_logprobs: Optional[int] = None,
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
+        logit_bias: Optional[Dict[int, float]] = None,
     ) -> GenerationResult:
         config = self.config
         prompt_ids, prompt_len, bucket = self._prep_prompt(prompt_ids)
@@ -903,6 +933,7 @@ class LocalEngine:
             and top_logprobs is None
             and frequency_penalty == 0.0
             and presence_penalty == 0.0
+            and logit_bias is None
         ):
             return self._generate_speculative(
                 prompt_ids, prompt_len, bucket, n, max_new_tokens,
@@ -915,6 +946,7 @@ class LocalEngine:
         loop = self._get_decode_loop(
             1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
+            use_logit_bias=logit_bias is not None,
         )
         toks, lps, done, tt, tl = loop(
             self.params,
@@ -923,6 +955,7 @@ class LocalEngine:
             first_logits,
             req_keys,
             eos_arr,
+            self._bias_array(logit_bias),
         )
 
         # ONE host transfer for all outputs: on relayed/remote device platforms
@@ -960,6 +993,7 @@ class LocalEngine:
         top_logprobs: Optional[int] = None,
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
+        logit_bias: Optional[Dict[int, float]] = None,
     ) -> List[GenerationResult]:
         """Decode several same-config requests as ONE batched XLA program.
 
@@ -990,6 +1024,7 @@ class LocalEngine:
                     top_logprobs=top_logprobs,
                     frequency_penalty=frequency_penalty,
                     presence_penalty=presence_penalty,
+                    logit_bias=logit_bias,
                 )
             ]
 
@@ -1050,9 +1085,11 @@ class LocalEngine:
         loop = self._get_decode_loop(
             r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
+            use_logit_bias=logit_bias is not None,
         )
         toks, lps, done, tt, tl = loop(
-            self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr
+            self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr,
+            self._bias_array(logit_bias),
         )
         toks_np, lps_np, done_np, tt_np, tl_np = jax.device_get(
             (toks, lps, done, tt, tl)

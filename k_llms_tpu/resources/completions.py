@@ -78,11 +78,25 @@ def _build_request(
         raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
     if temperature is not None and not 0.0 <= temperature <= 2.0:
         raise ValueError(f"temperature must be in [0, 2], got {temperature}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_p is not None and not 0.0 <= top_p <= 1.0:
+        # OpenAI's documented range is [0, 1]; top_p=0 degenerates to top-1
+        # (the boundary token always stays in the kept set).
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    for pname, pval in (("frequency_penalty", frequency_penalty),
+                        ("presence_penalty", presence_penalty)):
+        if pval is not None and not -2.0 <= pval <= 2.0:
+            raise ValueError(f"{pname} must be in [-2, 2], got {pval}")
+    logit_bias = kwargs.pop("logit_bias", None)
+    if logit_bias is not None:
+        for tok, bias in logit_bias.items():
+            if not -100.0 <= float(bias) <= 100.0:
+                raise ValueError(
+                    f"logit_bias values must be in [-100, 100], got {bias} for {tok}"
+                )
     return ChatRequest(
         logprobs=logprobs,
         top_logprobs=top_logprobs,
+        logit_bias=logit_bias,
         messages=messages,
         model=model,
         n=n or 1,

@@ -53,7 +53,7 @@ def test_temperature_out_of_range_rejected(client):
 
 
 def test_top_p_out_of_range_rejected(client):
-    for bad in (0.0, 1.5, -0.2):
+    for bad in (1.5, -0.2):
         with pytest.raises(ValueError, match="top_p"):
             client.chat.completions.create(messages=MSGS, model="tiny", n=1, top_p=bad)
 
@@ -92,3 +92,65 @@ def test_conflicting_config_and_model_rejected():
     # Agreeing values are fine.
     b = TpuBackend(model="tiny", config=BackendConfig(model="tiny"))
     assert b.model_name == "tiny"
+
+
+# -- logit_bias (the reference forwards it to the server; here the decode
+# loop applies it) -----------------------------------------------------------
+
+def test_logit_bias_bans_a_token(client):
+    """With +100 on both 'A' and 'B' greedy emits only those; additionally
+    banning 'A' (-100) must leave pure 'B' output."""
+    ab = client.chat.completions.create(
+        messages=MSGS, model="tiny", n=1, temperature=0.0, seed=5, max_tokens=4,
+        logit_bias={"65": 100, "66": 100},
+    )
+    assert set(ab.choices[0].message.content) <= {"A", "B"}
+    only_b = client.chat.completions.create(
+        messages=MSGS, model="tiny", n=1, temperature=0.0, seed=5, max_tokens=4,
+        logit_bias={"65": -100, "66": 100},
+    )
+    assert only_b.choices[0].message.content == "BBBB"
+
+
+def test_logit_bias_forces_a_token(client):
+    """+100 on one ordinary token dominates every step of greedy decode."""
+    target = 65  # 'A' in the byte tokenizer
+    r = client.chat.completions.create(
+        messages=MSGS, model="tiny", n=2, temperature=0.0, seed=6, max_tokens=4,
+        logit_bias={str(target): 100},
+    )
+    for choice in r.choices[1:]:
+        assert choice.message.content == "AAAA"
+
+
+def test_logit_bias_value_range_validated(client):
+    with pytest.raises(ValueError, match="logit_bias values"):
+        client.chat.completions.create(
+            messages=MSGS, model="tiny", n=1, logit_bias={"65": 500}
+        )
+
+
+def test_logit_bias_token_range_validated(client):
+    with pytest.raises(ValueError, match="outside vocab"):
+        client.chat.completions.create(
+            messages=MSGS, model="tiny", n=1, logit_bias={"999999": 1.0}
+        )
+
+
+def test_top_p_zero_is_top1(client):
+    # OpenAI accepts top_p=0 (degenerates to top-1); must serve, not 400.
+    r = client.chat.completions.create(
+        messages=MSGS, model="tiny", n=2, top_p=0.0, seed=8, max_tokens=2,
+    )
+    assert len(r.choices) == 3
+
+
+def test_penalty_out_of_range_rejected(client):
+    with pytest.raises(ValueError, match="frequency_penalty"):
+        client.chat.completions.create(
+            messages=MSGS, model="tiny", n=1, frequency_penalty=50.0
+        )
+    with pytest.raises(ValueError, match="presence_penalty"):
+        client.chat.completions.create(
+            messages=MSGS, model="tiny", n=1, presence_penalty=-3.0
+        )
