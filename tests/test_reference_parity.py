@@ -210,3 +210,87 @@ def test_parity_voting(seed):
 
     our_out = voting_consensus(values, ConsensusSettings())
     assert our_out == ref_out
+
+
+# ---------------------------------------------------------------------------
+# Gnarly fuzz: unicode, None/empty values, mixed types, scalar lists, large n
+# ---------------------------------------------------------------------------
+
+UNICODE = [
+    "café résumé naïve",
+    "déjà vu — touché",
+    "Ångström Σigma ñandú",
+    "日本語テキストの抽出フィールド",
+    "zażółć gęślą jaźń",
+]
+GNARLY_SCALARS = [
+    "", None, 0, 0.0, False, True, "42", 42, -0.0, 1e-9, 1e12,
+    "   spaced   out   ", "UPPER lower MiXeD",
+]
+
+
+def make_gnarly_record(rng):
+    rec = {
+        "title": rng.choice(UNICODE),
+        "tags": [rng.choice(ENUMS) for _ in range(rng.randint(0, 4))],
+        "scores": [round(rng.uniform(-10, 10), 3) for _ in range(rng.randint(0, 5))],
+        "misc": rng.choice(GNARLY_SCALARS),
+        "maybe": None if rng.random() < 0.4 else rng.choice(SENTENCES),
+        "count": rng.choice([0, 1, 7, 1000000, -3]),
+    }
+    if rng.random() < 0.5:
+        rec["nested"] = {
+            "inner": [
+                {"k": rng.choice(UNICODE), "v": rng.choice(GNARLY_SCALARS)}
+                for _ in range(rng.randint(0, 3))
+            ]
+        }
+    return rec
+
+
+def perturb_gnarly(rng, rec):
+    out = {}
+    for k, v in rec.items():
+        r = rng.random()
+        if r < 0.08:
+            continue  # drop field
+        if r < 0.16:
+            out[k] = rng.choice(GNARLY_SCALARS)  # type flip
+            continue
+        if isinstance(v, str):
+            out[k] = _perturb_string(rng, v, p=0.5)
+        elif isinstance(v, bool):
+            out[k] = (not v) if rng.random() < 0.3 else v
+        elif isinstance(v, (int, float)):
+            out[k] = _perturb_number(rng, v)  # ints stay ints when unperturbed
+        elif isinstance(v, list):
+            lst = [
+                perturb_gnarly(rng, x) if isinstance(x, dict)
+                else (_perturb_string(rng, x, p=0.4) if isinstance(x, str) else x)
+                for x in v
+            ]
+            if rng.random() < 0.4:
+                rng.shuffle(lst)
+            out[k] = lst
+        elif isinstance(v, dict):
+            out[k] = perturb_gnarly(rng, v)
+        else:
+            out[k] = v
+    return out
+
+
+@pytest.mark.parametrize("method", ["levenshtein", "jaccard"])
+@pytest.mark.parametrize("seed", range(15))
+def test_parity_gnarly_structures(seed, method):
+    """Unicode sanitization, None/empty-falsy similarity rules, mixed-type
+    fields, scalar-list alignment, and large n must all stay bit-compatible."""
+    rng = random.Random(10_000 + seed)
+    base = make_gnarly_record(rng)
+    n = rng.randint(2, 16)
+    samples = [perturb_gnarly(rng, base) for _ in range(n)]
+    our_aligned, our_value, our_conf, our_map = run_ours(samples, method)
+    ref_aligned, ref_value, ref_conf, ref_map = run_reference(samples, method)
+    assert our_aligned == ref_aligned, f"alignment diverged (seed={seed})"
+    assert our_value == ref_value, f"consensus value diverged (seed={seed})"
+    assert our_conf == ref_conf, f"likelihoods diverged (seed={seed})"
+    assert our_map == ref_map, f"key mappings diverged (seed={seed})"
