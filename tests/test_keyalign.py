@@ -201,3 +201,44 @@ def test_parity_recursive_align(seed):
     our_aligned, our_map = recursive_align(copy.deepcopy(values), "levenshtein", 0.5)
     assert list(ref_aligned) == list(our_aligned), f"seed={seed}"
     assert ref_map == our_map, f"seed={seed}"
+
+
+UNICODE_SKUS = ["café-α", "naïve-β", "déjà-γ", "ångström-δ", "日本-ε", "jaźń-ζ"]
+
+
+@pytestmark_ref
+@pytest.mark.parametrize("seed", range(10))
+def test_parity_recursive_align_unicode_keys(seed):
+    """Key selection and alignment over unicode join keys (accents, Greek,
+    CJK) must stay bit-compatible — the canonicalization/normalization path
+    is exactly where ASCII-only fuzz would hide divergence."""
+    _, _, kb = load_reference_keyalign()
+    rng = random.Random(900 + seed)
+    base = []
+    for sku in rng.sample(UNICODE_SKUS, rng.randint(2, 5)):
+        base.append({
+            "sku": sku,
+            "name": sku + " Ärtikel",
+            "price": round(rng.uniform(1, 50), 2),
+            "qty": rng.randint(1, 9),
+        })
+    values = []
+    for _ in range(rng.randint(2, 4)):
+        import copy
+
+        e = copy.deepcopy(base)
+        for rec in e:
+            if rng.random() < 0.3:
+                rec["price"] = round(rec["price"] + rng.uniform(-0.004, 0.004), 4)
+            if rng.random() < 0.2:
+                rec["name"] = rec["name"].upper()
+        rng.shuffle(e)
+        if rng.random() < 0.3 and len(e) > 1:
+            e.pop()
+        values.append({"doc": {"items": e}})
+    import copy
+
+    ref_aligned, ref_map = kb.recursive_align(copy.deepcopy(values), "levenshtein", 0.5)
+    our_aligned, our_map = recursive_align(copy.deepcopy(values), "levenshtein", 0.5)
+    assert list(ref_aligned) == list(our_aligned), f"seed={seed}"
+    assert ref_map == our_map, f"seed={seed}"
