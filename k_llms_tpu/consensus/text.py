@@ -6,52 +6,27 @@ Parity targets in `/root/reference/k_llms/utils/consensus_utils.py`:
 ``sanitize_value`` :925-933, ``key_normalization`` :764-774.
 
 The Levenshtein kernel is our native C++ (``k_llms_tpu.native``) instead of the
-python-Levenshtein wheel. Accent folding (the reference's ``unidecode``) is a
-NFKD-based transliteration with a small supplement table for the Latin letters NFKD
-cannot decompose; for the consensus vote keys (alnum-only, lowercased) this is
-equivalent on real-world data.
+python-Levenshtein wheel. Accent folding (the reference's ``unidecode``) is the
+first-party transliterator in ``translit.py``: unidecode-faithful tables for
+Latin/Cyrillic/Greek and a deterministic per-codepoint fallback for other
+scripts, so distinct non-Latin vote values never collapse into one bucket.
 """
 
 from __future__ import annotations
 
 import re
-import unicodedata
 from itertools import zip_longest
 
 from ..native import levenshtein_distance
 from .settings import SIMILARITY_SCORE_LOWER_BOUND
+from .translit import transliterate
 
 _NON_ALNUM = re.compile(r"[^a-zA-Z0-9]")
 
-# Latin letters with no NFKD decomposition, mapped the way unidecode maps them.
-_TRANSLIT = str.maketrans(
-    {
-        "ß": "ss",
-        "ẞ": "SS",
-        "æ": "ae",
-        "Æ": "AE",
-        "œ": "oe",
-        "Œ": "OE",
-        "ø": "o",
-        "Ø": "O",
-        "đ": "d",
-        "Đ": "D",
-        "ð": "d",
-        "Ð": "D",
-        "þ": "th",
-        "Þ": "Th",
-        "ł": "l",
-        "Ł": "L",
-        "ı": "i",
-        "İ": "I",
-    }
-)
-
 
 def ascii_fold(text: str) -> str:
-    """Best-effort ASCII transliteration (unidecode-lite)."""
-    text = text.translate(_TRANSLIT)
-    return unicodedata.normalize("NFKD", text).encode("ascii", "ignore").decode("ascii")
+    """ASCII transliteration (unidecode-equivalent; see ``translit.py``)."""
+    return transliterate(text)
 
 
 def normalize_string(text: str) -> str:

@@ -1,0 +1,186 @@
+"""First-party ASCII transliteration (unidecode-equivalent for the vote-key path).
+
+The reference sanitizes vote keys with the ``unidecode`` wheel
+(`/root/reference/k_llms/utils/consensus_utils.py:15`, applied at :925-933).
+This module supplies the same behavior without the dependency:
+
+* **Latin specials** — letters NFKD cannot decompose (ß, æ, ø, þ, ...), mapped
+  exactly as unidecode maps them.
+* **Cyrillic** — full Russian alphabet + common Ukrainian/Belarusian letters,
+  using unidecode's ALA-LC-style mappings (ж→zh, х→kh, щ→shch, ю→iu, я→ia, ...).
+* **Greek** — full alphabet incl. precomposed accents, unidecode's mappings
+  (θ→th, ξ→x, φ→ph, χ→kh, ψ→ps, η→e, ...).
+* **Everything else non-Latin** (CJK, kana, Arabic, Hebrew, Indic, ...) — a
+  deterministic per-codepoint token ``u<hex>`` for alphanumeric characters.
+  This *diverges* from unidecode (which romanizes, e.g. 北京 → "Bei Jing ") but
+  preserves the property that matters for voting: distinct strings stay
+  distinct, so "東京" and "北京" never collapse into one vote bucket.  The only
+  observable difference vs the reference is that a romanized Latin spelling and
+  its native-script spelling do not share a bucket (unidecode would sometimes
+  merge them).
+
+Tables are hand-derived from unidecode's documented mapping set and pinned by
+the fixture vectors in ``tests/fixtures/unidecode_vectors.py``.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+# Latin letters with no NFKD decomposition, mapped the way unidecode maps them.
+_LATIN = {
+    "ß": "ss",
+    "ẞ": "SS",
+    "æ": "ae",
+    "Æ": "AE",
+    "œ": "oe",
+    "Œ": "OE",
+    "ø": "o",
+    "Ø": "O",
+    "đ": "d",
+    "Đ": "D",
+    "ð": "d",
+    "Ð": "D",
+    "þ": "th",
+    "Þ": "Th",
+    "ł": "l",
+    "Ł": "L",
+    "ı": "i",
+    "İ": "I",
+}
+
+# Cyrillic, unidecode (ALA-LC-like) romanization.  Lowercase entries; uppercase
+# generated below with unidecode's title-style capitalization (Ж→"Zh").
+_CYRILLIC_LOWER = {
+    "а": "a",
+    "б": "b",
+    "в": "v",
+    "г": "g",
+    "д": "d",
+    "е": "e",
+    "ё": "io",
+    "ж": "zh",
+    "з": "z",
+    "и": "i",
+    "й": "i",
+    "к": "k",
+    "л": "l",
+    "м": "m",
+    "н": "n",
+    "о": "o",
+    "п": "p",
+    "р": "r",
+    "с": "s",
+    "т": "t",
+    "у": "u",
+    "ф": "f",
+    "х": "kh",
+    "ц": "ts",
+    "ч": "ch",
+    "ш": "sh",
+    "щ": "shch",
+    "ъ": '"',
+    "ы": "y",
+    "ь": "'",
+    "э": "e",
+    "ю": "iu",
+    "я": "ia",
+    # Ukrainian / Belarusian
+    "є": "ie",
+    "і": "i",
+    "ї": "i",
+    "ґ": "g",
+    "ў": "u",
+}
+
+_GREEK_LOWER = {
+    "α": "a",
+    "β": "b",
+    "γ": "g",
+    "δ": "d",
+    "ε": "e",
+    "ζ": "z",
+    "η": "e",
+    "θ": "th",
+    "ι": "i",
+    "κ": "k",
+    "λ": "l",
+    "μ": "m",
+    "ν": "n",
+    "ξ": "x",
+    "ο": "o",
+    "π": "p",
+    "ρ": "r",
+    "σ": "s",
+    "ς": "s",
+    "τ": "t",
+    "υ": "u",
+    "φ": "ph",
+    "χ": "kh",
+    "ψ": "ps",
+    "ω": "o",
+    # precomposed accents (also reachable via NFKD, but direct is exact)
+    "ά": "a",
+    "έ": "e",
+    "ή": "e",
+    "ί": "i",
+    "ό": "o",
+    "ύ": "u",
+    "ώ": "o",
+    "ϊ": "i",
+    "ϋ": "u",
+    "ΐ": "i",
+    "ΰ": "u",
+}
+
+
+def _with_upper(lower: dict[str, str]) -> dict[str, str]:
+    table = dict(lower)
+    for ch, out in lower.items():
+        up = ch.upper()
+        if len(up) == 1 and up != ch and up not in table:
+            # unidecode capitalizes the first romanized letter only (Щ → "Shch")
+            table[up] = out[:1].upper() + out[1:] if out and out[0].isalpha() else out
+    return table
+
+
+_TABLE: dict[int, str] = {
+    ord(k): v
+    for k, v in {
+        **_LATIN,
+        **_with_upper(_CYRILLIC_LOWER),
+        **_with_upper(_GREEK_LOWER),
+    }.items()
+}
+
+
+def transliterate(text: str) -> str:
+    """unidecode-equivalent ASCII transliteration.
+
+    Pipeline: mapped-script table → NFKD decomposition → per-char sweep that
+    keeps ASCII, drops combining marks, maps non-ASCII decimal digits to their
+    ASCII digit (unidecode parity), and tokenizes any remaining alphanumeric
+    codepoint as ``u<hex>`` so unmapped scripts stay distinct.
+    """
+    if text.isascii():
+        return text
+    text = text.translate(_TABLE)
+    decomposed = unicodedata.normalize("NFKD", text)
+    out: list[str] = []
+    for ch in decomposed:
+        cp = ord(ch)
+        if cp < 128:
+            out.append(ch)
+        elif unicodedata.combining(ch):
+            continue
+        elif cp in _TABLE:
+            # precomposed letters outside the table (e.g. ѝ, polytonic Greek)
+            # NFKD-decompose to a mapped base letter + combining mark
+            out.append(_TABLE[cp])
+        elif (digit := unicodedata.decimal(ch, None)) is not None:
+            out.append(str(digit))  # ٣ → 3, ३ → 3 (unidecode parity)
+        elif ch.isalnum():
+            out.append(f"u{cp:04x}")
+        # other non-ASCII symbols (punctuation, emoji, ...) are dropped, as the
+        # vote-key regex would strip them anyway
+    return "".join(out)

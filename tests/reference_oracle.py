@@ -58,13 +58,23 @@ def _install_stub_modules() -> None:
         cachetools.TTLCache = TTLCache
         sys.modules["cachetools"] = cachetools
 
-    # --- unidecode: mirror our ascii_fold so both engines sanitize identically ---
+    # --- unidecode: fixture-backed stub. Known inputs return REAL unidecode
+    # output (hand-encoded vectors), so oracle parity on those is genuine, not
+    # circular. Off-fixture inputs fall back to our transliterator, which the
+    # fixture tests (tests/test_translit.py) pin to unidecode behavior for
+    # Latin/Cyrillic/Greek. ---
     if "unidecode" not in sys.modules:
         unidecode_mod = _stub_module("unidecode")
 
-        from k_llms_tpu.consensus.text import ascii_fold
+        from k_llms_tpu.consensus.translit import transliterate
 
-        unidecode_mod.unidecode = ascii_fold
+        from fixtures.unidecode_vectors import UNIDECODE_TABLE
+
+        def _unidecode(text: str) -> str:
+            hit = UNIDECODE_TABLE.get(text)
+            return hit if hit is not None else transliterate(text)
+
+        unidecode_mod.unidecode = _unidecode
         sys.modules["unidecode"] = unidecode_mod
 
     # --- openai: classes + completion_usage types ---
