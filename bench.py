@@ -22,10 +22,23 @@ Prints ONE JSON line:
 
 import json
 import statistics
+import subprocess
+import sys
 import threading
 import time
 
 import jax
+
+# Relay-outage hardening (VERDICT r2 #1): the axon TPU relay can die and make
+# device init HANG (not error). Device availability is probed in a SUBPROCESS
+# with a hard timeout, retried with backoff, and the in-process jax.devices()
+# call only happens once a probe has succeeded. Mid-run UNAVAILABLE errors
+# retry the whole flagship section. On final failure the one-line JSON is
+# still printed, with an explicit "error" field, instead of a traceback.
+PROBE_ATTEMPTS = 8
+PROBE_TIMEOUT_S = 90
+PROBE_BACKOFF_S = 45
+RUN_RETRIES = 2
 
 RUNS = 3
 MAX_NEW = 64
@@ -66,6 +79,46 @@ def _decode_hbm_bytes_per_step(engine, n: int, prompt_len: int, max_new: int) ->
     )
     gen_bytes = cfg.num_layers * n * max_new * cfg.num_kv_heads * cfg.head_dim * kv_elem
     return int(weight_bytes + prefix_bytes + gen_bytes)
+
+
+def _device_probe_ok() -> bool:
+    """True once `jax.devices()` completes in a sandboxed subprocess — the
+    only safe way to detect a dead relay, which hangs instead of erroring."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                # Guard against JAX's silent CPU fallback: a refused (rather
+                # than hung) relay would otherwise let the bench "pass" the
+                # probe and time the 8B flagship on host CPU.
+                "import jax; ds = jax.devices(); "
+                "assert ds and all(d.platform != 'cpu' for d in ds), ds",
+            ],
+            timeout=PROBE_TIMEOUT_S,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def wait_for_device() -> None:
+    """Bounded retry/backoff until the device relay answers; raises after the
+    final attempt so main() can emit the structured-error JSON."""
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        if _device_probe_ok():
+            return
+        print(
+            f"# device probe {attempt}/{PROBE_ATTEMPTS} failed; retrying in {PROBE_BACKOFF_S}s",
+            file=sys.stderr,
+        )
+        if attempt < PROBE_ATTEMPTS:
+            time.sleep(PROBE_BACKOFF_S)
+    raise RuntimeError(
+        f"device unavailable after {PROBE_ATTEMPTS} probe attempts "
+        f"({PROBE_TIMEOUT_S}s timeout each)"
+    )
 
 
 def bench_flagship() -> "tuple[dict, object, object]":
@@ -213,31 +266,75 @@ def bench_concurrency(backend, client) -> dict:
     }
 
 
-def main() -> None:
-    flagship, backend, client = bench_flagship()
-    concurrency = bench_concurrency(backend, client)
+def bench_quality() -> dict:
+    """Host-side consensus quality on the scripted noise model (hermetic —
+    needs no device, so it runs first and survives a relay outage).
 
-    # Host-side consensus quality on the scripted noise model (hermetic).
+    ``tuned`` is the headline serving config (alignment refinement + canonical
+    spelling, the documented opt-in knobs); ``reference_faithful`` runs the
+    bit-identical-to-reference defaults for contrast — it shows the high-n
+    row-drop the knobs fix. Both run n in {8,16,32} over 3 distinct truth
+    documents (VERDICT r2 #3)."""
+    from k_llms_tpu.consensus.settings import ConsensusSettings
     from k_llms_tpu.utils.quality import consensus_quality_eval
 
-    quality = consensus_quality_eval()
-
-    ratio = flagship["ratio"]
-    print(
-        json.dumps(
-            {
-                "metric": "n32_consensus_p50_over_single_p50",
-                "value": ratio,
-                "unit": "x",
-                "vs_baseline": round(2.0 / ratio, 4),
-                "detail": {
-                    "flagship": flagship,
-                    "concurrency": concurrency,
-                    "quality": quality,
-                },
-            }
-        )
+    tuned_settings = ConsensusSettings(
+        alignment_refinement_rounds=2, canonical_spelling=True
     )
+    return {
+        "tuned": consensus_quality_eval(
+            n_values=(8, 16, 32), trials=12, consensus_settings=tuned_settings
+        ),
+        "reference_faithful": consensus_quality_eval(n_values=(8, 16, 32), trials=12),
+    }
+
+
+def _emit(value, vs_baseline, detail: dict, error: "str | None" = None) -> None:
+    line = {
+        "metric": "n32_consensus_p50_over_single_p50",
+        "value": value,
+        "unit": "x",
+        "vs_baseline": vs_baseline,
+        "detail": detail,
+    }
+    if error is not None:
+        line["error"] = error
+    print(json.dumps(line))
+
+
+def main() -> None:
+    detail: dict = {}
+    try:
+        detail["quality"] = bench_quality()
+    except Exception as exc:  # quality is hermetic; a failure here is a bug
+        detail["quality"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+
+    last_error = None
+    for attempt in range(1, RUN_RETRIES + 2):
+        try:
+            wait_for_device()
+        except Exception as exc:
+            # Probe exhaustion: report it only if no real run error was seen.
+            last_error = last_error or f"{type(exc).__name__}: {exc}"[:500]
+            break
+        try:
+            flagship, backend, client = bench_flagship()
+            detail["flagship"] = flagship
+            detail["concurrency"] = bench_concurrency(backend, client)
+            ratio = flagship["ratio"]
+            _emit(ratio, round(2.0 / ratio, 4), detail)
+            return
+        except Exception as exc:
+            last_error = f"{type(exc).__name__}: {exc}"[:500]
+            print(
+                f"# flagship attempt {attempt}/{RUN_RETRIES + 1} failed: {last_error}",
+                file=sys.stderr,
+            )
+            if "UNAVAILABLE" not in last_error and "unavailable" not in last_error:
+                break  # a genuine bug — retrying (and re-probing) would only mask it
+
+    _emit(None, None, detail, error=last_error)
+    sys.exit(1)
 
 
 if __name__ == "__main__":

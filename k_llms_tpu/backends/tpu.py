@@ -72,6 +72,16 @@ class BackendConfig(BaseModel):
     # checkpoints, ~1.4x slower at zero acceptance (see ops/speculative.py).
     speculative: Optional[str] = None
     spec_lookahead: int = 4
+    # Decode-admission window (seconds): after dequeuing a request the
+    # scheduler holds the batch open this long for same-key arrivals to
+    # coalesce. Every request that reaches an EMPTY queue pays it — ~5 ms on
+    # a ~1 s decode. Set 0.0 for latency-critical solo deployments (burst
+    # coalescing then relies on queue backlog alone).
+    # NB: speculative decoding runs only through the SOLO path — coalesced
+    # bursts take generate_many's normal loop (spec_stats reports
+    # {"mode": "coalesced_fallback"} there), so under concurrency a larger
+    # window trades speculation's per-request speedup for batch throughput.
+    batch_window: float = 0.005
 
 
 class TpuBackend(Backend):
@@ -145,7 +155,9 @@ class TpuBackend(Backend):
         # (AsyncKLLMs, threads) serialize cleanly instead of racing jit caches.
         from ..engine.scheduler import EngineScheduler
 
-        self.scheduler = EngineScheduler(name=self.model_name)
+        self.scheduler = EngineScheduler(
+            name=self.model_name, batch_window=cfg.batch_window
+        )
         self._dfa_cache: Dict[str, Any] = {}
 
     # -- chat -------------------------------------------------------------
@@ -175,6 +187,24 @@ class TpuBackend(Backend):
                 if not 0 <= t < V:
                     raise ValueError(f"logit_bias token id {t} outside vocab (0..{V-1})")
                 logit_bias[t] = float(bias)
+        stop_strings: List[str] = []
+        if isinstance(request.stop, str):
+            stop_strings = [request.stop]
+        elif isinstance(request.stop, list):
+            stop_strings = [s for s in request.stop if s]
+        # Tokenized stop sequences halt rows ON DEVICE (engine suffix match);
+        # the text scan below stays authoritative for BPE re-tokenization
+        # boundary cases and over-long stops. Only device-matchable lengths are
+        # handed down — the engine warns on drops, which would be spurious here
+        # since this path always has the host fallback.
+        from ..engine.engine import MAX_STOP_LEN
+
+        stop_seqs = [
+            ids_s
+            for ids_s in (tok.encode(s) for s in stop_strings)
+            if 0 < len(ids_s) <= MAX_STOP_LEN
+        ] or None
+
         result = self._generate_batched(
             prompt_ids,
             n=n,
@@ -187,28 +217,36 @@ class TpuBackend(Backend):
             frequency_penalty=float(request.frequency_penalty or 0.0),
             presence_penalty=float(request.presence_penalty or 0.0),
             logit_bias=logit_bias,
+            stop_sequences=stop_seqs,
         )
-
-        stop_strings: List[str] = []
-        if isinstance(request.stop, str):
-            stop_strings = [request.stop]
-        elif isinstance(request.stop, list):
-            stop_strings = [s for s in request.stop if s]
 
         choices: List[Dict[str, Any]] = []
         completion_tokens = 0
         for i in range(n):
             length = int(result.lengths[i])
             ids = [int(t) for t in result.tokens[i][:length]]
-            completion_tokens += length
             text = tok.decode(ids)
             finish = result.finish_reasons[i]
-            for s in stop_strings:
-                pos = text.find(s)
-                if pos != -1:
-                    text = text[:pos]
-                    finish = "stop"
-                    break
+            # OpenAI semantics: truncate at the EARLIEST stop occurrence in the
+            # text, whichever stop string produced it.
+            cuts = [pos for s in stop_strings if (pos := text.find(s)) != -1]
+            if cuts:
+                pos = min(cuts)
+                text = text[:pos]
+                finish = "stop"
+                # Usage counts only tokens that contribute to the VISIBLE text
+                # (OpenAI neither returns nor continues past the stop): binary
+                # search the shortest token prefix covering it — decoded length
+                # is monotone in the token count.
+                lo, hi = 0, length
+                while lo < hi:
+                    mid = (lo + hi) // 2
+                    if len(tok.decode(ids[:mid])) >= pos:
+                        hi = mid
+                    else:
+                        lo = mid + 1
+                length = lo
+            completion_tokens += length
             logprobs_payload = None
             if request.logprobs:
                 def _top_entries(step: int):
@@ -286,6 +324,7 @@ class TpuBackend(Backend):
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
         logit_bias: Optional[Dict[int, float]] = None,
+        stop_sequences: Optional[List[List[int]]] = None,
     ):
         """Submit one generation through the coalescing scheduler: concurrent
         requests with the same sampling config decode as ONE batched XLA
@@ -303,9 +342,12 @@ class TpuBackend(Backend):
         # The bias CONTENT is part of the compatibility key — coalesced rows
         # share one bias vector, so only identical biases may fuse.
         bias_key = tuple(sorted(logit_bias.items())) if logit_bias else None
+        # Stop CONTENT keys the batch too: coalesced rows share one device
+        # stop matrix, so only identical stop sets may fuse.
+        stop_key = tuple(map(tuple, stop_sequences)) if stop_sequences else None
         batch_key = (
             max_new, temperature, top_p, ckey, tuple(eos_ids), top_logprobs,
-            frequency_penalty, presence_penalty, bias_key,
+            frequency_penalty, presence_penalty, bias_key, stop_key,
         )
 
         def run(specs):
@@ -320,6 +362,7 @@ class TpuBackend(Backend):
                 frequency_penalty=frequency_penalty,
                 presence_penalty=presence_penalty,
                 logit_bias=logit_bias,
+                stop_sequences=stop_sequences,
             )
 
         # Weight = this request's padded row count (the engine rounds n up to a

@@ -164,8 +164,48 @@ class _Group:
     source_lists: set = field(default_factory=set)
 
 
+def _refinement_pass(
+    table: ElementTable, groups: List[_Group], threshold: float
+) -> Tuple[List[_Group], bool]:
+    """One global re-assignment round over stable representatives.
+
+    Every element joins the most-similar CURRENT medoid rep above ``threshold``
+    (one element per source list per group), then each group re-elects a
+    content-space medoid (argmax of mean member-to-member similarity). Unlike
+    the greedy founding scan, all elements see the same final reps, so a
+    cluster that fragmented across competing part-formed groups re-coalesces.
+    """
+    old_reps = sorted(g.rep for g in groups)
+    shells = [_Group(rep=g.rep) for g in groups]
+    for r in range(len(table)):
+        src = int(table.owner[r])
+        best: Optional[_Group] = None
+        best_sim = -1.0
+        for g in shells:
+            if src in g.source_lists:
+                continue
+            s = table.sim[r, g.rep]
+            if s >= threshold and s > best_sim:
+                best_sim = s
+                best = g
+        if best is None:
+            best = _Group(rep=r)
+            shells.append(best)
+        best.members.append(r)
+        best.source_lists.add(src)
+    shells = [g for g in shells if g.members]
+    for g in shells:
+        member_rows = np.array(g.members)
+        block = table.sim[np.ix_(member_rows, member_rows)]
+        g.rep = int(member_rows[int(np.argmax(block.mean(axis=1)))])
+    return shells, sorted(g.rep for g in shells) != old_reps
+
+
 def _elect_reference(
-    table: ElementTable, threshold: float, min_support_ratio: float
+    table: ElementTable,
+    threshold: float,
+    min_support_ratio: float,
+    refinement_rounds: int = 0,
 ) -> List[Index]:
     """Elect reference elements by greedy similarity grouping.
 
@@ -209,6 +249,11 @@ def _elect_reference(
             best.rep = elected_row
             groups.remove(best)
             groups.append(best)
+
+    for _ in range(refinement_rounds):
+        groups, changed = _refinement_pass(table, groups, threshold)
+        if not changed:
+            break
 
     n_lists = len(table.lists)
     ranked = [
@@ -279,6 +324,7 @@ def lists_alignment(
     min_support_ratio: float = 0.5,
     max_novelty_ratio: float = 0.25,
     reference_list_idx: Optional[int] = None,
+    refinement_rounds: int = 0,
 ) -> Tuple[List[List[Any]], List[List[Optional[int]]]]:
     """Align lists of objects by element similarity.
 
@@ -298,7 +344,7 @@ def lists_alignment(
         return aligned, _original_positions(aligned, list_of_lists)
 
     threshold = _compute_dynamic_threshold(table)
-    reference = _elect_reference(table, threshold, min_support_ratio)
+    reference = _elect_reference(table, threshold, min_support_ratio, refinement_rounds)
     aligned = _assign_to_reference(table, reference, threshold=0.95 * threshold)
     aligned = _prune_low_support_elements(aligned, min_support_ratio)
     return sort_by_original_majority(aligned, list_of_lists)

@@ -16,6 +16,7 @@ the reference's hardcoded OpenAI ``gpt-5-mini`` call (:1026-1048).
 from __future__ import annotations
 
 import math
+from collections import Counter
 from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
@@ -137,13 +138,27 @@ def _numeric_consensus(
 
 
 def _medoid_consensus(
-    values: List[Any], scorer: SimilarityScorer, parent_valid_frac: float
+    values: List[Any],
+    scorer: SimilarityScorer,
+    parent_valid_frac: float,
+    canonical_spelling: bool = False,
 ) -> Tuple[Any, float]:
     """Similarity medoid (spec :1221-1237): the value with the highest mean
-    similarity to the others wins; that mean (scaled) is the confidence."""
+    similarity to the others wins; that mean (scaled) is the confidence.
+
+    With ``canonical_spelling`` (opt-in, see ConsensusSettings) ties at the
+    max mean break toward the most frequent exact value among the tied
+    candidates instead of np.argmax's first-index rule — normalized-identical
+    case variants stop winning on position."""
     sim = _pairwise_matrix(values, scorer, diag=np.nan)
     mean_to_others = np.nanmean(sim, axis=1)
     best = int(np.argmax(mean_to_others))
+    if canonical_spelling:
+        tied = np.flatnonzero(mean_to_others >= mean_to_others[best] - 1e-12)
+        if tied.size > 1:
+            freq: Counter = Counter(repr(values[i]) for i in tied)
+            top = max(freq[repr(values[i])] for i in tied)
+            best = int(next(i for i in tied if freq[repr(values[i])] == top))
     return values[best], round(parent_valid_frac * float(mean_to_others[best]), 5)
 
 
@@ -246,7 +261,9 @@ def consensus_as_primitive(
         return _numeric_consensus(values, consensus_settings, parent_valid_frac)
 
     # (c) similarity medoid (strings or other structures).
-    return _medoid_consensus(values, scorer, parent_valid_frac)
+    return _medoid_consensus(
+        values, scorer, parent_valid_frac, consensus_settings.canonical_spelling
+    )
 
 
 def compute_similarity_scores(values: list, scorer: SimilarityScorer) -> list:

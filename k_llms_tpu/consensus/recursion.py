@@ -57,6 +57,7 @@ def _descend_keys(
     max_novelty_ratio: float,
     prefix: str,
     reference_idx: Optional[int],
+    refinement_rounds: int = 0,
 ) -> Tuple[List[Any], PathMap]:
     """Per-key recursion over dict samples (Nones become empty shells; every
     output dict carries the full key union, in sorted order)."""
@@ -71,6 +72,7 @@ def _descend_keys(
             max_novelty_ratio=max_novelty_ratio,
             current_path=f"{prefix}.{key}" if prefix else key,
             reference_idx=reference_idx,
+            refinement_rounds=refinement_rounds,
         )
         for shell, aligned in zip(shells, column):
             shell[key] = aligned
@@ -85,6 +87,7 @@ def _descend_positions(
     max_novelty_ratio: float,
     prefix: str,
     reference_idx: Optional[int],
+    refinement_rounds: int = 0,
 ) -> Tuple[List[Any], PathMap]:
     """Structural alignment of list samples, then per-column recursion with the
     path map rewritten through each sample's pre-alignment positions."""
@@ -97,6 +100,7 @@ def _descend_positions(
             min_support_ratio=min_support_ratio,
             max_novelty_ratio=max_novelty_ratio,
             reference_list_idx=reference_idx,
+            refinement_rounds=refinement_rounds,
         )
     else:
         rows = [[] for _ in rows]
@@ -111,6 +115,7 @@ def _descend_positions(
             max_novelty_ratio=max_novelty_ratio,
             current_path="",
             reference_idx=reference_idx,
+            refinement_rounds=refinement_rounds,
         )
         for r, v in zip(rows, aligned_col):
             r[col] = v
@@ -135,6 +140,7 @@ def recursive_list_alignments(
     max_novelty_ratio: float = 0.25,
     current_path: str = "",
     reference_idx: Optional[int] = None,
+    refinement_rounds: int = 0,
 ) -> Tuple[List[Any], PathMap]:
     """Recursively align nested dicts/lists across the n samples.
 
@@ -154,11 +160,13 @@ def recursive_list_alignments(
 
     if uniform and head is dict:
         return _descend_keys(
-            values, scorer, min_support_ratio, max_novelty_ratio, current_path, reference_idx
+            values, scorer, min_support_ratio, max_novelty_ratio, current_path,
+            reference_idx, refinement_rounds,
         )
     if uniform and head is list:
         return _descend_positions(
-            values, scorer, min_support_ratio, max_novelty_ratio, current_path, reference_idx
+            values, scorer, min_support_ratio, max_novelty_ratio, current_path,
+            reference_idx, refinement_rounds,
         )
 
     # Scalars and mixed-type levels pass through untouched; a sample maps to the

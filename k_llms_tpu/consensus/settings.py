@@ -52,5 +52,21 @@ class ConsensusSettings(BaseModel):
     # Majority threshold for voting (slightly easier for small n if maj_loosen_k>0)
     base_maj_thresh: float = 0.6
     maj_loosen_k: float = 0.1
+    # Global refinement passes after the greedy reference election. The
+    # reference's single greedy scan (consensus_utils.py:255-333) is
+    # order-dependent: at high n one true cluster can fragment into several
+    # groups that each miss min_support_ratio and get pruned, silently
+    # dropping list rows the majority of samples agree on. Each refinement
+    # round re-assigns every element to its best stable medoid representative
+    # and re-elects medoids, undoing the fragmentation. 0 = reference-exact
+    # behavior; 2 is enough in practice (recommended for n >= 16).
+    alignment_refinement_rounds: int = 0
+    # Report vote/medoid winners in the bucket's most COMMON exact spelling
+    # instead of the first-seen one. The reference returns the first original
+    # whose sanitized form matches the winning key (consensus_utils.py:970),
+    # so a case-mangled sample that happens to sit first speaks for the whole
+    # bucket; with this knob the majority spelling wins and that error rate
+    # decays with n instead of staying constant. False = reference-exact.
+    canonical_spelling: bool = False
     # Robust mean (used only when n >= 5)
     trim_frac: float = 0.2

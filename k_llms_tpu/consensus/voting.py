@@ -61,8 +61,22 @@ def voting_consensus(
         for v, wi in zip(processed_values, valid_weights):
             tallies[v] += wi
         best_normalized, best_count = tallies.most_common(1)[0]
-        # Report the winner in its original (first-seen) spelling.
-        best_val = valid_values[processed_values.index(best_normalized)]
+        if consensus_settings.canonical_spelling:
+            # Opt-in: report the bucket's most common exact spelling (weighted;
+            # ties broken by first occurrence).
+            spelling: Counter = Counter()
+            for v, pv, wi in zip(valid_values, processed_values, valid_weights):
+                if pv == best_normalized:
+                    spelling[v] += wi
+            top = max(spelling.values())
+            best_val = next(
+                v
+                for v, pv in zip(valid_values, processed_values)
+                if pv == best_normalized and spelling[v] == top
+            )
+        else:
+            # Report the winner in its original (first-seen) spelling.
+            best_val = valid_values[processed_values.index(best_normalized)]
 
     confidence = parent_valid_frac * (best_count / total_weight)
     return (best_val, round(confidence, 5))

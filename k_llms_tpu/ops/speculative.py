@@ -133,3 +133,11 @@ def scatter_rows(buf: jax.Array, values: jax.Array, offsets: jax.Array) -> jax.A
     return jax.vmap(
         lambda b, v, o: jax.lax.dynamic_update_slice_in_dim(b, v, o, axis=0)
     )(buf, values, offsets)
+
+
+def scatter_rows_k(buf: jax.Array, values: jax.Array, offsets: jax.Array) -> jax.Array:
+    """scatter_rows for per-position top-k payloads: buf [B, T, K],
+    values [B, W, K], offsets [B] — the trailing top-k axis rides along."""
+    return jax.vmap(
+        lambda b, v, o: jax.lax.dynamic_update_slice(b, v, (o, 0))
+    )(buf, values, offsets)

@@ -38,6 +38,56 @@ DEFAULT_TRUTH: Dict[str, Any] = {
     ],
 }
 
+# Distinct document shapes so the eval is not tuned to one structure
+# (VERDICT r2: >=3 truth documents): a purchase order heavy on enums/booleans
+# and a long-list shape, and a candidate profile with long free-text strings
+# (exercising the >50-char embeddings gate) and a deeply nested record.
+PO_TRUTH: Dict[str, Any] = {
+    "po_number": "PO-88-3312",
+    "status": "approved",
+    "expedited": True,
+    "buyer": {"name": "Dana Whitfield", "department": "Facilities Operations"},
+    "approvals": ["manager", "finance", "legal"],
+    "items": [
+        {"sku": "CHR-0042", "name": "Ergonomic task chair", "qty": 24, "price": 219.99, "in_stock": True},
+        {"sku": "DSK-1107", "name": "Standing desk, walnut", "qty": 24, "price": 540.0, "in_stock": False},
+        {"sku": "LMP-0093", "name": "LED desk lamp", "qty": 30, "price": 42.5, "in_stock": True},
+        {"sku": "CBL-2210", "name": "Cable management tray", "qty": 48, "price": 18.75, "in_stock": True},
+    ],
+}
+
+PROFILE_TRUTH: Dict[str, Any] = {
+    "name": "Priya Raghunathan",
+    "headline": "Staff infrastructure engineer focused on large-scale stream processing and storage",
+    "years_experience": 11,
+    "remote": False,
+    "summary": (
+        "Led the migration of a petabyte-scale event pipeline onto a tiered "
+        "object-storage architecture, cutting storage spend by forty percent"
+    ),
+    "skills": ["distributed systems", "capacity planning", "incident response"],
+    "positions": [
+        {
+            "company": "Meridian Data Systems",
+            "title": "Staff Engineer",
+            "start_year": 2021,
+            "achievement": "Designed the cross-region replication layer that now carries all production traffic",
+        },
+        {
+            "company": "Halcyon Analytics",
+            "title": "Senior Engineer",
+            "start_year": 2017,
+            "achievement": "Rebuilt the ingestion tier around idempotent batch commits, halving duplicate rates",
+        },
+    ],
+}
+
+TRUTH_DOCS: Dict[str, Dict[str, Any]] = {
+    "invoice": DEFAULT_TRUTH,
+    "purchase_order": PO_TRUTH,
+    "profile": PROFILE_TRUTH,
+}
+
 
 # ---------------------------------------------------------------------------
 # Noise model
@@ -161,6 +211,7 @@ def consensus_quality_eval(
     noise: float = 0.15,
     seed: int = 0,
     truth: Optional[Dict[str, Any]] = None,
+    consensus_settings=None,
 ) -> Dict[str, float]:
     """Run the full public pipeline on scripted noisy samples and score it.
 
@@ -178,26 +229,33 @@ def consensus_quality_eval(
     from ..backends.fake import FakeBackend
     from ..client import KLLMs
 
-    truth = truth if truth is not None else DEFAULT_TRUTH
+    # One explicit truth keeps the old single-document behavior; default runs
+    # every document in TRUTH_DOCS and averages (each doc weighs equally).
+    docs = {"truth": truth} if truth is not None else TRUTH_DOCS
     results: Dict[str, float] = {}
     single_accs: List[float] = []
 
     for n in n_values:
         cons_accs: List[float] = []
-        for t in range(trials):
-            samples = make_noisy_samples(truth, n, noise, seed + 1000 * t + n)
-            client = KLLMs(backend=FakeBackend(responses=[samples]), model="m")
-            resp = client.chat.completions.create(
-                messages=[{"role": "user", "content": "extract"}], model="m", n=n
-            )
-            consensus = json.loads(resp.choices[0].message.content)
-            cons_accs.append(field_accuracy(consensus, truth))
-            for c in resp.choices[1:]:
-                try:
-                    single_accs.append(field_accuracy(json.loads(c.message.content), truth))
-                except json.JSONDecodeError:  # pragma: no cover
-                    single_accs.append(0.0)
+        for doc_idx, doc in enumerate(docs.values()):
+            for t in range(trials):
+                samples = make_noisy_samples(doc, n, noise, seed + 1000 * t + n + 77777 * doc_idx)
+                client = KLLMs(backend=FakeBackend(responses=[samples]), model="m")
+                resp = client.chat.completions.create(
+                    messages=[{"role": "user", "content": "extract"}],
+                    model="m",
+                    n=n,
+                    consensus_settings=consensus_settings,
+                )
+                consensus = json.loads(resp.choices[0].message.content)
+                cons_accs.append(field_accuracy(consensus, doc))
+                for c in resp.choices[1:]:
+                    try:
+                        single_accs.append(field_accuracy(json.loads(c.message.content), doc))
+                    except json.JSONDecodeError:  # pragma: no cover
+                        single_accs.append(0.0)
         results[f"consensus_n{n}"] = round(sum(cons_accs) / len(cons_accs), 4)
 
     results["single_sample"] = round(sum(single_accs) / len(single_accs), 4)
+    results["truth_docs"] = len(docs)
     return results

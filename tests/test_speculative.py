@@ -148,15 +148,95 @@ def test_spec_respects_eos(engines):
     assert (r.tokens[:, 1:] == spec.config.pad_token_id).all()
 
 
-def test_spec_falls_back_for_unsupported_features(engines):
-    """Constraints / penalties / top_logprobs route through the normal loop."""
+def _assert_spec_ran(spec):
+    # the sentinel modes mark normal-loop fallbacks; absence = spec loop served
+    assert "mode" not in spec.spec_stats, spec.spec_stats
+
+
+def test_spec_composes_penalties(engines):
+    """VERDICT r2 #4: frequency/presence penalties run UNDER speculation with
+    normal-loop semantics — greedy chains must match token-for-token (the
+    per-position penalty counts are closed-form over the draft prefix)."""
+    normal, spec = engines
+    kw = dict(
+        n=2, max_new_tokens=12, temperature=0.0, seed=6,
+        frequency_penalty=0.7, presence_penalty=0.3,
+    )
+    r_n = normal.generate(PROMPT, **kw)
+    r_s = spec.generate(PROMPT, **kw)
+    _assert_spec_ran(spec)
+    np.testing.assert_array_equal(r_s.tokens, r_n.tokens)
+    np.testing.assert_allclose(r_s.logprobs, r_n.logprobs, rtol=1e-4, atol=1e-4)
+    assert r_s.finish_reasons == r_n.finish_reasons
+
+
+def test_spec_composes_logit_bias(engines):
+    normal, spec = engines
+    bias = {int(PROMPT[0]): 4.0, int(PROMPT[1]): -6.0}
+    kw = dict(n=2, max_new_tokens=10, temperature=0.0, seed=8, logit_bias=bias)
+    r_n = normal.generate(PROMPT, **kw)
+    r_s = spec.generate(PROMPT, **kw)
+    _assert_spec_ran(spec)
+    np.testing.assert_array_equal(r_s.tokens, r_n.tokens)
+
+
+def test_spec_composes_top_logprobs(engines):
+    """Per-position top-k alternatives captured in the verify loop must equal
+    the normal loop's, position by position."""
+    normal, spec = engines
+    kw = dict(n=2, max_new_tokens=8, temperature=0.0, seed=4, top_logprobs=3)
+    r_n = normal.generate(PROMPT, **kw)
+    r_s = spec.generate(PROMPT, **kw)
+    _assert_spec_ran(spec)
+    np.testing.assert_array_equal(r_s.tokens, r_n.tokens)
+    for i in range(2):
+        ln = int(r_n.lengths[i])
+        np.testing.assert_array_equal(
+            r_s.top_tokens[i][:ln], r_n.top_tokens[i][:ln]
+        )
+        np.testing.assert_allclose(
+            r_s.top_logprobs[i][:ln], r_n.top_logprobs[i][:ln], rtol=1e-4, atol=1e-4
+        )
+
+
+def test_spec_composes_json_constraint(engines):
+    """The grammar automaton advances across accepted drafts: greedy
+    constrained output matches the normal constrained loop exactly, and every
+    sample is valid JSON."""
+    import json as _json
+
+    normal, spec = engines
+    eos = [normal.config.eos_token_id]
+    kw = dict(
+        n=2, max_new_tokens=24, temperature=0.0, seed=5,
+        constraint="json", eos_ids=eos,
+    )
+    r_n = normal.generate(PROMPT, **kw)
+    r_s = spec.generate(PROMPT, **kw)
+    _assert_spec_ran(spec)
+    np.testing.assert_array_equal(r_s.tokens, r_n.tokens)
+    np.testing.assert_array_equal(r_s.lengths, r_n.lengths)
+    # sampled constrained spec output is also structurally valid
+    r = spec.generate(
+        PROMPT, n=3, max_new_tokens=32, temperature=0.9, seed=123,
+        constraint="json", eos_ids=eos,
+    )
+    _assert_spec_ran(spec)
+    for row, ln, fin in zip(r.tokens, r.lengths, r.finish_reasons):
+        if fin == "stop":
+            text = bytes(t for t in row[:ln] if t < 256).decode("utf-8", "replace")
+            _json.loads(text)
+
+
+def test_spec_still_falls_back_on_mesh_or_stops(engines):
+    """Remaining documented fallbacks: device stop sequences."""
     _, spec = engines
     r = spec.generate(
         PROMPT, n=2, max_new_tokens=4, temperature=0.8, seed=5,
-        frequency_penalty=0.5,
+        stop_sequences=[[int(PROMPT[0])]],
     )
     assert r.tokens.shape == (2, 4)
-    assert spec._decode_cache  # normal loop compiled (fallback taken)
+    assert spec.spec_stats == {"mode": "fallback"}
 
 
 def test_backend_plumbs_speculative():
