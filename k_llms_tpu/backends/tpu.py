@@ -59,6 +59,10 @@ class BackendConfig(BaseModel):
     sp_prefill_min_tokens: Optional[int] = None
     # Context-parallel attention for SP prefill: "ring" | "ulysses".
     sp_attention: str = "ring"
+    # Ring DECODE against the SP-resident prefix: the SP prefill's KV stays
+    # sequence-sharded and decode attends it in place (P-1 ring hops per
+    # step), keeping long-context serving O(S/P) per device end-to-end.
+    sp_decode: bool = False
     # Prompt-prefix KV cache: keep the last N full-prompt KV caches on device
     # and reuse the longest common token prefix (>= prefix_cache_min_reuse
     # tokens) of any of them, prefilling only the suffix. Serves the
@@ -145,6 +149,7 @@ class TpuBackend(Backend):
             quantize=cfg.quantization or False,
             sp_prefill_min_tokens=cfg.sp_prefill_min_tokens,
             sp_attention=cfg.sp_attention,
+            sp_decode=cfg.sp_decode,
             prefix_cache_size=cfg.prefix_cache_size,
             prefix_cache_min_reuse=cfg.prefix_cache_min_reuse,
             speculative=cfg.speculative,
@@ -194,16 +199,17 @@ class TpuBackend(Backend):
             stop_strings = [s for s in request.stop if s]
         # Tokenized stop sequences halt rows ON DEVICE (engine suffix match);
         # the text scan below stays authoritative for BPE re-tokenization
-        # boundary cases and over-long stops. Only device-matchable lengths are
-        # handed down — the engine warns on drops, which would be spurious here
-        # since this path always has the host fallback.
-        from ..engine.engine import MAX_STOP_LEN
+        # boundary cases and over-long/overflow stops. Only device-matchable
+        # ones (length AND count) are handed down — the engine warns on drops,
+        # which would be spurious here since this path always has the host
+        # fallback.
+        from ..engine.engine import MAX_STOP_LEN, MAX_STOP_SEQS
 
         stop_seqs = [
             ids_s
             for ids_s in (tok.encode(s) for s in stop_strings)
             if 0 < len(ids_s) <= MAX_STOP_LEN
-        ] or None
+        ][:MAX_STOP_SEQS] or None
 
         result = self._generate_batched(
             prompt_ids,

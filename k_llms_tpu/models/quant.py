@@ -48,6 +48,70 @@ WeightLike = Union[jax.Array, QTensor, Q4Tensor]
 # Matmul weights to quantize (all contract over axis -2). Embeddings and norms
 # stay in the model dtype.
 _QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# Megatron-style tensor-parallel layout of the quantized matmuls: column-
+# parallel weights shard output columns over the model axis; row-parallel
+# weights shard the contraction axis (their matmul psums partials).
+_COL_PARALLEL_KEYS = frozenset({"wq", "wk", "wv", "w_gate", "w_up"})
+_ROW_PARALLEL_KEYS = frozenset({"wo", "w_down"})
+
+
+def _dense_quant_shapes(config) -> "Dict[str, tuple]":
+    """(K, N) of each dense quantized matmul (MoE expert stacks are 4D with
+    the layer axis and int4-ineligible, so they are not listed)."""
+    H, I = config.hidden_size, config.intermediate_size
+    Q, KV = config.q_dim, config.kv_dim
+    return {
+        "wq": (H, Q),
+        "wk": (H, KV),
+        "wv": (H, KV),
+        "wo": (Q, H),
+        "w_gate": (H, I),
+        "w_up": (H, I),
+        "w_down": (I, H),
+    }
+
+
+def int4_mesh_compatible(config, tp: int) -> bool:
+    """True when every int4-eligible weight can shard over ``tp`` model-axis
+    devices without splitting a quantization group (row-parallel needs
+    K % (GROUP*tp) == 0) or fracturing columns (col-parallel needs
+    N % tp == 0). MoE configs keep int4 off the experts already."""
+    from ..ops.w4matmul import GROUP
+
+    if tp <= 1:
+        return True
+    if config.num_experts > 0:
+        return False  # expert einsums have no sharded-int4 path
+    shapes = dict(_dense_quant_shapes(config))
+    shapes["lm_head"] = (config.hidden_size, config.vocab_size)
+    for key, (k, n) in shapes.items():
+        ndim = 2 if key == "lm_head" else 3
+        if not _int4_eligible_shape(ndim, k, n):
+            continue  # stays int8, XLA partitions it natively
+        if key in _ROW_PARALLEL_KEYS:
+            if k % (GROUP * tp):
+                return False
+        elif n % tp:
+            return False
+    return True
+
+
+def mark_int4_partitioning(params: "Dict[str, Any]", mesh) -> "Dict[str, Any]":
+    """Stamp every Q4Tensor leaf-node with its tensor-parallel layout + mesh so
+    ``qdot`` routes through the shard_mapped kernel. Idempotent; trees without
+    Q4 nodes pass through unchanged (checkpoint loads arrive unmarked)."""
+    layers = dict(params["layers"])
+    for key in _QUANT_LAYER_KEYS:
+        w = layers.get(key)
+        if isinstance(w, Q4Tensor):
+            part = "col" if key in _COL_PARALLEL_KEYS else "row"
+            layers[key] = Q4Tensor(w.q, w.scale, part=part, mesh=mesh)
+    out = dict(params)
+    out["layers"] = layers
+    head = out.get("lm_head")
+    if isinstance(head, Q4Tensor):
+        out["lm_head"] = Q4Tensor(head.q, head.scale, part="col", mesh=mesh)
+    return out
 
 
 def quantize_weight(w: jax.Array) -> QTensor:
@@ -68,7 +132,13 @@ def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
     the XLA dequant reference inside :func:`w4_matmul`."""
     if isinstance(w, Q4Tensor):
         x2 = x.reshape(-1, x.shape[-1])
-        out = w4_matmul(x2, w, interpret=jax.default_backend() != "tpu")
+        interpret = jax.default_backend() != "tpu"
+        if w.part is not None and w.mesh is not None:
+            from ..ops.w4matmul import w4_matmul_tp
+
+            out = w4_matmul_tp(x2, w, interpret=interpret)
+        else:
+            out = w4_matmul(x2, w, interpret=interpret)
         return out.reshape(*x.shape[:-1], w.q.shape[-1])
     if isinstance(w, QTensor):
         out = x @ w.q.astype(x.dtype)
@@ -202,10 +272,17 @@ def init_params_quantized(config, key: jax.Array, dtype=None, bits: int = 8) -> 
     }
 
 
-def quantized_param_specs(specs: Dict[str, Any]) -> Dict[str, Any]:
+def quantized_param_specs(
+    specs: Dict[str, Any], bits: int = 8, config=None
+) -> Dict[str, Any]:
     """Map a bf16 param-spec tree to the quantized tree: the int8 payload keeps
     the weight's spec; the scale keeps it too except on the contraction axis
-    (size 1 after the keepdims reduce — an axis of size 1 can't shard)."""
+    (size 1 after the keepdims reduce — an axis of size 1 can't shard).
+
+    With ``bits=4`` (requires ``config`` for the shapes), int4-eligible keys
+    get Q4Tensor spec nodes instead — both the packed payload ([.., K/2, N])
+    and the per-group scale ([.., K/GROUP, N]) keep the weight's spec, since
+    group packing is blocked along the contraction axis."""
 
     def scale_spec(spec: P) -> P:
         parts = list(spec)
@@ -213,10 +290,25 @@ def quantized_param_specs(specs: Dict[str, Any]) -> Dict[str, Any]:
             parts[-2] = None
         return P(*parts)
 
+    q4_keys = set()
+    if bits == 4 and config is not None:
+        for key, (k, n) in _dense_quant_shapes(config).items():
+            if config.num_experts > 0 and key in ("w_gate", "w_up", "w_down"):
+                continue  # 4D expert stacks stay int8
+            if _int4_eligible_shape(3, k, n):
+                q4_keys.add(key)
+        if _int4_eligible_shape(2, config.hidden_size, config.vocab_size):
+            q4_keys.add("lm_head")
+
+    def qspec(key: str, spec: P):
+        if key in q4_keys:
+            return Q4Tensor(q=spec, scale=spec)
+        return QTensor(q=spec, scale=scale_spec(spec))
+
     layers = dict(specs["layers"])
     for key in _QUANT_LAYER_KEYS:
-        layers[key] = QTensor(q=layers[key], scale=scale_spec(layers[key]))
+        layers[key] = qspec(key, layers[key])
     out = dict(specs)
     out["layers"] = layers
-    out["lm_head"] = QTensor(q=specs["lm_head"], scale=scale_spec(specs["lm_head"]))
+    out["lm_head"] = qspec("lm_head", specs["lm_head"])
     return out

@@ -57,6 +57,7 @@ def attention_xla(
 def _flash_kernel(
     keylen_ref,  # [B, 1] int32 in SMEM: valid (prefix) key count per batch row
     window_ref,  # [1, 1] int32 in SMEM: sliding window (2^30 = no window)
+    qoff_ref,  # [1, 1] int32 in SMEM: absolute position of query row 0
     q_ref,  # [1, 1, block_q, D]
     k_ref,  # [1, 1, block_k, D]
     v_ref,  # [1, 1, block_k, D]
@@ -81,7 +82,10 @@ def _flash_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q_start = qi * block_q
+    # q_offset shifts queries to ABSOLUTE positions (continuation prefill:
+    # query row 0 sits at position prefix_len over a key space that starts at
+    # the sequence's position 0). Zero for ordinary same-origin prefill.
+    q_start = qi * block_q + qoff_ref[0, 0]
     k_start = ki * block_k
 
     def _compute():
@@ -120,7 +124,8 @@ def _flash_kernel(
         m_ref[:] = m_new
 
     if causal:
-        # Skip K blocks entirely above the causal diagonal.
+        # Skip K blocks entirely above the causal diagonal (q_start already
+        # carries the traced absolute offset, so this stays exact under it).
         pl.when(k_start <= q_start + block_q - 1)(_compute)
     else:
         _compute()
@@ -291,6 +296,7 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     softcap: Optional[float] = None,
     window=None,
+    q_offset=None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
@@ -302,7 +308,10 @@ def flash_attention(
     cap*tanh(s/cap) to the scaled scores. ``window`` limits each query to the
     last W keys — a static int or a TRACED scalar, so alternating-window
     configs (Gemma-2) can select W per scanned layer without recompiling.
-    Returns [B, QH, Sq, D].
+    ``q_offset`` (static int or traced scalar) is the absolute position of
+    query row 0 — the continuation-prefill mode, where a suffix of queries
+    attends a key space rooted at position 0; causality and windows are
+    evaluated at row + q_offset. Returns [B, QH, Sq, D].
 
     Sq/Sk pad to block multiples internally; GQA maps query head h onto kv head
     h // (QH // KVH) via the BlockSpec index maps.
@@ -323,6 +332,7 @@ def flash_attention(
     if window is None:
         window = NO_WINDOW
     window_arr = jnp.asarray(window, jnp.int32).reshape(1, 1)
+    qoff_arr = jnp.asarray(0 if q_offset is None else q_offset, jnp.int32).reshape(1, 1)
     if Sk_pad != Sk:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
@@ -347,6 +357,7 @@ def flash_attention(
         in_specs=[
             pl.BlockSpec((B, 1), lambda b, h, qi, ki: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda b, h, qi, ki: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda b, h, qi, ki: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
@@ -358,6 +369,6 @@ def flash_attention(
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(key_lengths, window_arr, q, k, v)
+    )(key_lengths, window_arr, qoff_arr, q, k, v)
 
     return out[:, :, :Sq, :]

@@ -100,6 +100,95 @@ def ring_attention_local(
     return (acc / safe_l).astype(q.dtype)
 
 
+def ring_decode_prefix(
+    mesh: Mesh,
+    q: jax.Array,
+    prefix_k: jax.Array,
+    prefix_v: jax.Array,
+    prefix_len: jax.Array,
+    *,
+    seq_axis: str = "data",
+    model_axis: str = "model",
+    sm_scale: Optional[float] = None,
+):
+    """Decode-step attention over a SEQUENCE-SHARDED prefix: the ring decode
+    half of O(S/P) long-context serving (the SP prefill already leaves its KV
+    sharded over ``seq_axis``; this attends it in place instead of
+    all-gathering a replicated copy).
+
+    q: [B, QH, D] with B sharded over ``seq_axis`` (the decode batch layout)
+    and QH over ``model_axis``; prefix_k/v: [1, S, KVH, D] with S over
+    ``seq_axis`` and KVH over ``model_axis``; prefix_len: scalar valid key
+    count. Queries stay put; K/V chunks rotate the ring (P-1 ppermute hops
+    per decode step) with online-softmax accumulation. Returns
+    (out [B, QH, D] f32 — normalized within the prefix phase, m [B, QH],
+    l [B, QH]) — the same contract as ``decode_prefix_attention``, so the
+    caller's exact logsumexp merge with the generated tail applies unchanged.
+    """
+
+    def local(q, pk, pv, plen):
+        B_local, QH, D = q.shape
+        S_local = pk.shape[1]
+        KVH = pk.shape[2]
+        G = QH // KVH
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+        p_size = lax.psum(1, seq_axis)
+        my_idx = lax.axis_index(seq_axis)
+
+        qg = q.astype(jnp.float32).reshape(B_local, KVH, G, D)
+        # Accumulators become varying over every axis the inputs vary on
+        # (sequence ring + model-sharded heads), so mark them up front.
+        vary = tuple(a for a in (seq_axis, model_axis) if a in mesh.axis_names)
+        acc0 = lax.pvary(jnp.zeros((B_local, QH, D), jnp.float32), vary)
+        m0 = lax.pvary(jnp.full((B_local, QH), NEG_INF, jnp.float32), vary)
+        l0 = lax.pvary(jnp.zeros((B_local, QH), jnp.float32), vary)
+
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+        def step(i, carry):
+            acc, m, l, k_cur, v_cur = carry
+            src = (my_idx - i) % p_size
+            cols = src * S_local + jnp.arange(S_local)
+            valid = cols < plen  # [S_local]
+            # [B, KVH, G, D] x [S, KVH, D] -> [B, KVH, G, S]
+            s = jnp.einsum(
+                "bhgd,shd->bhgs", qg, k_cur[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+            s = s.reshape(B_local, QH, S_local)
+
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_cur)
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, :, None])
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            delta = jnp.einsum(
+                "bhgs,shd->bhgd",
+                p.reshape(B_local, KVH, G, S_local),
+                v_cur[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).reshape(B_local, QH, D)
+            acc_new = acc * alpha[:, :, None] + delta
+            k_nxt = lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = lax.ppermute(v_cur, seq_axis, perm)
+            return (acc_new, m_new, l_new, k_nxt, v_nxt)
+
+        acc, m, l, _, _ = lax.fori_loop(0, p_size, step, (acc0, m0, l0, pk, pv))
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        return acc / safe_l[:, :, None], m, l
+
+    q_spec = P(seq_axis, model_axis, None)
+    kv_spec = P(None, seq_axis, model_axis, None)
+    out_spec = (q_spec, P(seq_axis, model_axis), P(seq_axis, model_axis))
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P()),
+        out_specs=out_spec,
+    )(q, prefix_k, prefix_v, prefix_len)
+
+
 def ring_attention(
     mesh: Mesh,
     q: jax.Array,

@@ -33,23 +33,46 @@ bf16, so no precision is lost to the weight cast.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 GROUP = 128  # contraction rows per quantization group (one scale each)
 _HALF = GROUP // 2
 
 
-class Q4Tensor(NamedTuple):
+@jax.tree_util.register_pytree_node_class
+class Q4Tensor:
     """Packed int4 weight: ``q`` int8 [..., K/2, N] (two nibbles per byte along
-    the contraction axis), ``scale`` f32 [..., K/GROUP, N]."""
+    the contraction axis), ``scale`` f32 [..., K/GROUP, N].
 
-    q: jax.Array
-    scale: jax.Array
+    ``part``/``mesh`` are STATIC pytree metadata (not serialized — the engine
+    re-marks after checkpoint load) describing how the weight is sharded under
+    tensor parallelism: ``part="col"`` = output columns over the model axis
+    (Megatron column-parallel), ``part="row"`` = contraction rows over the
+    model axis (row-parallel; the sharded matmul psums). None = unsharded —
+    ``qdot`` then runs the plain single-shard kernel.
+    """
+
+    def __init__(self, q, scale, part: Optional[str] = None, mesh=None):
+        self.q = q
+        self.scale = scale
+        self.part = part
+        self.mesh = mesh
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.part, self.mesh)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, part=aux[0], mesh=aux[1])
+
+    def __repr__(self):
+        return f"Q4Tensor(q={self.q!r}, scale={self.scale!r}, part={self.part!r})"
 
     @property
     def k_dim(self) -> int:
@@ -179,3 +202,56 @@ def w4_matmul(
         interpret=interpret,
     )(x, w.q, w.scale)
     return out[:rows]
+
+
+def w4_matmul_tp(x: jax.Array, w: Q4Tensor, *, interpret: bool = False) -> jax.Array:
+    """``x @ dequant(w)`` with the kernel shard_mapped over the weight's
+    tensor-parallel layout (``w.part``/``w.mesh`` — VERDICT r2 #7).
+
+    - ``col``: output columns sharded over the model axis; each device runs
+      the kernel on its [K, N/TP] shard, activations replicated over model.
+    - ``row``: contraction rows sharded; activations arrive model-sharded on
+      their last dim (the Megatron row-parallel input layout), each device
+      contracts its K/TP rows and the partials psum over the model axis.
+      Group alignment holds because K % (GROUP * TP) is enforced by
+      ``int4_mesh_compatible`` — a quantization group never splits devices.
+    Rows (the batch dim) stay sharded over the data axis throughout.
+    """
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    mesh = w.mesh
+    # Shard the batch rows over the data axis when they divide evenly (decode
+    # batches, prefill sequences); odd row counts (the 1-row last-token logits
+    # call) replicate over data instead.
+    rows_axis = DATA_AXIS if x.shape[0] % mesh.shape[DATA_AXIS] == 0 else None
+    if w.part == "col":
+        in_specs = (
+            P(rows_axis, None),
+            P(None, MODEL_AXIS),
+            P(None, MODEL_AXIS),
+        )
+        out_specs = P(rows_axis, MODEL_AXIS)
+
+        def local(xs, q, s):
+            return w4_matmul(xs, Q4Tensor(q=q, scale=s), interpret=interpret)
+
+    elif w.part == "row":
+        in_specs = (
+            P(rows_axis, MODEL_AXIS),
+            P(MODEL_AXIS, None),
+            P(MODEL_AXIS, None),
+        )
+        out_specs = P(rows_axis, None)
+
+        def local(xs, q, s):
+            part = w4_matmul(xs, Q4Tensor(q=q, scale=s), interpret=interpret)
+            return jax.lax.psum(part, MODEL_AXIS)
+
+    else:  # pragma: no cover - callers gate on part
+        raise ValueError(f"unknown partition kind {w.part!r}")
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, which the checker would otherwise reject inside shard_map.
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )(x, w.q, w.scale)

@@ -79,3 +79,30 @@ def test_single_sample_failure_does_not_fail_request():
         messages=[{"role": "user", "content": "q"}], model="m", n=3
     )
     assert resp.choices[0].message.content == "ok answer"
+
+
+def test_list_form_preserves_original_sample_indexes():
+    """List-of-completions form: a sample with EMPTY choices is skipped, but
+    the surviving samples keep their ORIGINAL positions in choice.index —
+    compacting would silently misattribute outputs to the wrong sample."""
+
+    def one(content):
+        return ChatCompletion.model_validate(
+            {
+                "id": "c",
+                "created": 0,
+                "model": "m",
+                "object": "chat.completion",
+                "choices": [] if content is None else [
+                    {
+                        "finish_reason": "stop",
+                        "index": 0,
+                        "message": {"role": "assistant", "content": content},
+                    }
+                ],
+            }
+        )
+
+    comps = [one('{"a": 1}'), one(None), one('{"a": 1}')]
+    result = consolidate_chat_completions(comps, SimilarityScorer.levenshtein())
+    assert [c.index for c in result.choices] == [0, 1, 3]

@@ -194,3 +194,54 @@ def test_flash_dynamic_window_traced():
     ref_full = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
     np.testing.assert_allclose(np.asarray(full), np.asarray(ref_full), rtol=2e-5, atol=2e-5)
     assert not np.allclose(np.asarray(windowed), np.asarray(full))
+
+
+def test_flash_q_offset_continuation_matches_full():
+    """q_offset mode (continuation prefill): the suffix queries of a full
+    causal attention must equal running flash on only those queries with
+    q_offset = prefix length, against the full key space."""
+    q, k, v = _qkv(7)
+    P = 24  # prefix length; suffix queries are rows P..S
+    full = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
+    suffix = flash_attention(
+        q[:, :, P:], k, v, causal=True, q_offset=P, block_q=16, block_k=16,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(suffix), np.asarray(full[:, :, P:]), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_q_offset_traced_scalar():
+    """q_offset as a traced scalar (the engine passes prefix_len dynamically)."""
+    q, k, v = _qkv(8)
+    P = 17
+
+    @jax.jit
+    def run(qs, k, v, off):
+        return flash_attention(
+            qs, k, v, causal=True, q_offset=off, block_q=16, block_k=16,
+            interpret=True,
+        )
+
+    suffix = run(q[:, :, P:], k, v, jnp.int32(P))
+    full = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(suffix), np.asarray(full[:, :, P:]), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_q_offset_with_window():
+    """Sliding windows are evaluated at absolute (offset) positions."""
+    q, k, v = _qkv(9)
+    W, P = 20, 16
+    full = flash_attention(
+        q, k, v, causal=True, window=W, block_q=16, block_k=16, interpret=True
+    )
+    suffix = flash_attention(
+        q[:, :, P:], k, v, causal=True, window=W, q_offset=P, block_q=16,
+        block_k=16, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(suffix), np.asarray(full[:, :, P:]), rtol=2e-5, atol=2e-5
+    )

@@ -180,3 +180,94 @@ def test_init_params_quantized_bits4_shapes():
     assert isinstance(gate, Q4Tensor)
     assert gate.q.shape == (2, 128, 512)
     assert gate.scale.shape == (2, 2, 512)
+
+
+# -- int4 under tensor parallelism (VERDICT r2 #7) ---------------------------
+
+def _int4_cfg():
+    from k_llms_tpu.models import get_config
+
+    return get_config("tiny").with_(
+        hidden_size=256,
+        intermediate_size=512,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=64,
+        vocab_size=384,
+        max_seq_len=128,
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_int4_on_mesh_bitcompares_single_chip():
+    """quantization="int4" survives a data=4 x model=2 mesh (shard_mapped
+    w4a16) and produces the single-chip engine's exact tokens/logprobs."""
+    from k_llms_tpu.engine.engine import LocalEngine
+    from k_llms_tpu.models import init_params
+    from k_llms_tpu.parallel.mesh import make_mesh
+
+    cfg = _int4_cfg()
+    params = init_params(cfg, jax.random.key(4))
+    solo = LocalEngine(cfg, params=params, use_mesh=False, quantize="int4")
+    mesh = make_mesh(4, 2)
+    tp = LocalEngine(cfg, params=params, mesh=mesh, quantize="int4")
+    assert tp.quantized == "int4"  # no silent int8 downgrade any more
+    assert tp.params["layers"]["wo"].part == "row"
+    assert tp.params["layers"]["wq"].part == "col"
+
+    prompt = [5, 6, 7, 8, 9]
+    kw = dict(n=4, max_new_tokens=6, temperature=0.0, seed=3)
+    r_solo = solo.generate(prompt, **kw)
+    r_tp = tp.generate(prompt, **kw)
+    np.testing.assert_array_equal(r_tp.tokens, r_solo.tokens)
+    np.testing.assert_allclose(r_tp.logprobs, r_solo.logprobs, rtol=1e-4, atol=1e-4)
+
+    # sampled path too (same seed stream on both engines)
+    kw = dict(n=4, max_new_tokens=4, temperature=0.9, seed=17)
+    np.testing.assert_array_equal(
+        tp.generate(prompt, **kw).tokens, solo.generate(prompt, **kw).tokens
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_int4_downgrades_when_groups_would_split():
+    """tp=4 over a K=256 row-parallel weight would split a quantization group
+    (needs K % (128*4) == 0) — the engine must fall back to int8, loudly."""
+    from k_llms_tpu.engine.engine import LocalEngine
+    from k_llms_tpu.models.quant import int4_mesh_compatible
+    from k_llms_tpu.parallel.mesh import make_mesh
+
+    cfg = _int4_cfg()
+    assert int4_mesh_compatible(cfg, 2)
+    assert not int4_mesh_compatible(cfg, 4)
+    eng = LocalEngine(cfg, mesh=make_mesh(2, 4), quantize="int4")
+    assert eng.quantized == "int8"
+
+
+def test_int4_fmt_marker_roundtrip(tmp_path):
+    """Checkpoints record the quantized layout explicitly (fmt leaf) instead
+    of relying on the scale-shape heuristic (ADVICE r2)."""
+    from k_llms_tpu.models import init_params
+    from k_llms_tpu.models.loader import load_orbax, save_checkpoint
+    from k_llms_tpu.models.quant import QTensor, quantize_params
+
+    # intermediate_size=384: w_down has K=384 (not a 256 multiple), so the
+    # tree is a GENUINE int4/int8 mix — both fmt branches get exercised.
+    cfg = _int4_cfg().with_(intermediate_size=384)
+    qp = quantize_params(init_params(cfg, jax.random.key(1)), bits=4)
+    assert isinstance(qp["layers"]["w_down"], QTensor)
+    path = str(tmp_path / "ckpt4")
+    save_checkpoint(path, qp)
+    restored = load_orbax(path)
+    assert isinstance(restored["layers"]["w_gate"], Q4Tensor)
+    assert isinstance(restored["lm_head"], Q4Tensor)
+    assert isinstance(restored["layers"]["w_down"], QTensor)  # fmt=8 branch
+    np.testing.assert_array_equal(
+        np.asarray(restored["layers"]["w_gate"].q),
+        np.asarray(qp["layers"]["w_gate"].q),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored["layers"]["w_down"].q),
+        np.asarray(qp["layers"]["w_down"].q),
+    )
