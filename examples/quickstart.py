@@ -1,0 +1,82 @@
+"""Quickstart: the reference's README usage, running fully locally on the TPU
+engine (zero OpenAI calls). Mirrors `/root/reference/README.md` "Usage" so a
+k-LLMs user can see the one-line switch: `KLLMs()` -> `KLLMs(backend="tpu")`.
+
+Run from the repo root (hermetic; uses the tiny random-init model so it works
+anywhere — put a real checkpoint path in BackendConfig for production):
+
+    python examples/quickstart.py
+"""
+
+import asyncio
+
+from pydantic import BaseModel
+
+from k_llms_tpu import AsyncKLLMs, KLLMs
+
+# ---------------------------------------------------------------------------
+# Basic usage — consensus via the `n` parameter (reference README "Basic
+# Usage"; the remote OpenAI call becomes one batched on-device decode).
+# ---------------------------------------------------------------------------
+client = KLLMs(backend="tpu", model="tiny")
+
+response = client.chat.completions.create(
+    model="tiny",
+    messages=[{"role": "user", "content": "What is 2+2?"}],
+    n=3,  # 3 samples decoded as ONE batched XLA program, then consolidated
+    seed=7,
+)
+print("consensus:", response.choices[0].message.content[:60])
+print("originals:", [len(c.message.content or "") for c in response.choices[1:]])
+print("likelihoods:", response.likelihoods)
+
+
+# ---------------------------------------------------------------------------
+# Structured outputs with parse() — grammar-constrained decoding guarantees
+# every sample is valid for the schema (the reference delegates this to the
+# OpenAI server; here a schema-compiled DFA masks logits on device).
+# ---------------------------------------------------------------------------
+class UserInfo(BaseModel):
+    name: str
+    age: int
+
+
+result = client.chat.completions.parse(
+    model="tiny",
+    messages=[{"role": "user", "content": "John is 30 years old"}],
+    response_format=UserInfo,
+    n=3,
+    seed=11,
+    max_tokens=96,
+)
+consensus_user = result.choices[0].message.parsed  # consolidated UserInfo
+original_users = [c.message.parsed for c in result.choices[1:]]
+# Every sample is schema-valid JSON *as far as it got*: the DFA masks logits
+# so invalid structure is impossible. The random-init tiny model may still
+# run out of max_tokens before closing a string (finish_reason "length"),
+# in which case .parsed degrades to None — with a real checkpoint, samples
+# finish with "stop" and .parsed is always populated.
+print("sample finish reasons:", [c.finish_reason for c in result.choices[1:]])
+print("sample contents start with valid JSON:",
+      [(c.message.content or "")[:9] for c in result.choices[1:]])
+print("parsed consensus:", consensus_user)
+print("parsed originals:", original_users)
+print("field likelihoods:", result.likelihoods)
+
+
+# ---------------------------------------------------------------------------
+# Async usage — same engine underneath; concurrent requests coalesce into one
+# batched decode through the scheduler instead of racing the device.
+# ---------------------------------------------------------------------------
+async def main():
+    aclient = AsyncKLLMs(backend=client.backend)  # share the loaded engine
+    out = await aclient.chat.completions.create(
+        model="tiny",
+        messages=[{"role": "user", "content": "Hello!"}],
+        n=3,
+        seed=3,
+    )
+    print("async consensus:", out.choices[0].message.content[:60])
+
+
+asyncio.run(main())

@@ -54,6 +54,18 @@ MAX_STOP_SEQS = 4
 MAX_STOP_LEN = 8
 
 
+def stop_window_match(window: jax.Array, stops: jax.Array) -> jax.Array:
+    """[B, L] rolling token window vs [S, L] right-aligned -1-padded stop
+    sequences: -1 padding positions auto-match, and a stop only counts if it
+    has at least one real token. Shared by the normal and speculative decode
+    loops so halting semantics can never drift apart. Returns [B] bool."""
+    pad_pos = stops < 0
+    eq = window[:, None, :] == stops[None, :, :]
+    row_hit = jnp.all(eq | pad_pos[None, :, :], axis=-1)  # [B, S]
+    live = jnp.any(~pad_pos, axis=-1)  # [S]
+    return jnp.any(row_hit & live[None, :], axis=-1)
+
+
 def _constraint_ops(constraint):
     """Uniform grammar-automaton interface for a decode loop: returns
     ``(tables, initial_state, mask_logits, advance)`` where state is always a
@@ -195,18 +207,39 @@ class LocalEngine:
         else:
             if quantize:
                 # Quantize on device (jitted) so the bf16 tree never has to fit
-                # alongside a second full copy in HBM per-shard.
+                # alongside a second full copy in HBM per-shard. A PRE-quantized
+                # checkpoint keeps its stored layout (quantize_weight_bits), so
+                # the spec tree must follow the actual leaves, not the request.
+                from ..models.quant import align_quantized_specs
+
+                put_specs = align_quantized_specs(params, qspecs, pspecs)
                 qz = jax.jit(
                     partial(quantize_params, bits=bits),
-                    out_shardings=self._shard_tree(qspecs) if self.mesh is not None else None,
+                    out_shardings=self._shard_tree(put_specs) if self.mesh is not None else None,
                 )
                 params = qz(params)
             elif self.mesh is not None:
                 params = jax.device_put(params, self._shard_tree(pspecs))
-        if quantize == "int4" and self.mesh is not None:
-            from ..models.quant import mark_int4_partitioning
+        if self.mesh is not None and quantize:
+            # Mark every int4 leaf with its TP layout — whatever its origin
+            # (fresh int4 init, or a pre-quantized checkpoint whose stored
+            # int4 layout survives an int8 request). An unmarked Q4Tensor on a
+            # mesh would hand GSPMD an unpartitionable pallas call.
+            from ..models.quant import (
+                int4_mesh_compatible,
+                mark_int4_partitioning,
+                tree_has_q4,
+            )
 
-            params = mark_int4_partitioning(params, self.mesh)
+            if tree_has_q4(params):
+                if not int4_mesh_compatible(self.config, mesh.shape.get(MODEL_AXIS, 1)):
+                    raise ValueError(
+                        f"checkpoint stores int4 weights whose quantization "
+                        f"groups cannot shard over model parallel="
+                        f"{mesh.shape.get(MODEL_AXIS, 1)} for {self.config.name}; "
+                        "re-quantize to int8 or change the mesh"
+                    )
+                params = mark_int4_partitioning(params, self.mesh)
         self.params = params
 
         # Sequence-parallel prefill threshold: prompts at least this long
@@ -618,14 +651,7 @@ class LocalEngine:
             done0 = jnp.isin(tok0, eos_ids)
 
             def _stop_match(recent):
-                # [B, L] window vs [S, L] right-aligned stops: -1 padding
-                # positions auto-match, and a stop only counts if it has at
-                # least one real token.
-                pad_pos = stops < 0
-                eq = recent[:, None, :] == stops[None, :, :]
-                row_hit = jnp.all(eq | pad_pos[None, :, :], axis=-1)  # [B, S]
-                live = jnp.any(~pad_pos, axis=-1)  # [S]
-                return jnp.any(row_hit & live[None, :], axis=-1)  # [B]
+                return stop_window_match(recent, stops)
 
             if use_stops:
                 recent0 = (
@@ -738,6 +764,7 @@ class LocalEngine:
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
         use_logit_bias: bool = False,
+        use_stops: bool = False,
     ):
         """Jitted prompt-lookup speculative loop (single request, no mesh).
 
@@ -771,7 +798,7 @@ class LocalEngine:
         cache_key = (
             "spec", n_per, max_new, temperature, top_p, top_k, K, bucket,
             constraint_key, top_logprobs, frequency_penalty, presence_penalty,
-            use_logit_bias,
+            use_logit_bias, use_stops,
         )
         fn = self._spec_decode_cache.get(cache_key)
         if fn is not None:
@@ -802,7 +829,10 @@ class LocalEngine:
             """where() with ``cond`` [B] broadcast over a/b's trailing dims."""
             return jnp.where(cond.reshape(cond.shape + (1,) * (a.ndim - 1)), a, b)
 
-        def _loop(params, prefix, prompt_tokens, prompt_len, first_logits, req_key, eos_ids, bias):
+        def _loop(
+            params, prefix, prompt_tokens, prompt_len, first_logits, req_key,
+            eos_ids, bias, stops,
+        ):
             sample = partial(
                 sample_logits, temperature=temperature, top_p=top_p, top_k=top_k
             )
@@ -810,6 +840,9 @@ class LocalEngine:
 
             def _mask_pad(lg):
                 return lg.at[:, pad_id].add(pad_col)
+
+            def _stop_match(window):
+                return stop_window_match(window, stops)
 
             jstate = initial_state(B) if cops is not None else None
 
@@ -841,6 +874,13 @@ class LocalEngine:
                 vcounts0 = vcounts0.at[jnp.arange(B), tok0].add(1.0)
             count0 = jnp.ones((B,), jnp.int32)
             eos0 = jnp.isin(tok0, eos_ids)
+            if use_stops:
+                recent0 = (
+                    jnp.full((B, MAX_STOP_LEN), -1, jnp.int32).at[:, -1].set(tok0)
+                )
+                eos0 = eos0 | _stop_match(recent0)  # "stop" finish either way
+            else:
+                recent0 = jnp.zeros((B, 0), jnp.int32)
             done0 = eos0 | (count0 >= max_new)
 
             gen_cache = init_cache(config, B, BUF)
@@ -852,7 +892,7 @@ class LocalEngine:
             def body(state):
                 (
                     it, count, done, hit_eos_any, row_iters, cache, toks, lps,
-                    tt, tlb, vcounts, jst,
+                    tt, tlb, vcounts, jst, recent,
                 ) = state
                 row_iters = row_iters + jnp.where(done, 0, 1)  # verifies entered
                 cur = jnp.take_along_axis(toks, (count - 1)[:, None], axis=1)[:, 0]
@@ -928,6 +968,35 @@ class LocalEngine:
                 emit, counts_new, hit_eos = accept_drafts(
                     sampled, drafts, eos_ids, budget
                 )
+                stop_hit = jnp.zeros((B,), bool)
+                if use_stops:
+                    # Stop sequences can complete MID-emission: evaluate the
+                    # rolling window at every emitted position and truncate the
+                    # run at the first match (the matched position itself still
+                    # emits, like the normal loop's same-step halt).
+                    buf2 = jnp.concatenate([recent, sampled], axis=1)  # [B, L+K+1]
+                    hits = (
+                        jnp.stack(
+                            [
+                                _stop_match(buf2[:, j + 1 : j + 1 + MAX_STOP_LEN])
+                                for j in range(K + 1)
+                            ],
+                            axis=1,
+                        )
+                        & emit
+                    )
+                    stop_hit = jnp.any(hits, axis=1)
+                    keep = jnp.where(stop_hit, jnp.argmax(hits, axis=1), K + 1)
+                    emit = emit & (jnp.arange(K + 1)[None, :] <= keep[:, None])
+                    counts_new = emit.sum(axis=1).astype(jnp.int32)
+                    hit_eos = jnp.any(emit & jnp.isin(sampled, eos_ids), axis=1)
+                    # Window after emission: the L tokens ending at the new
+                    # count (counts_new == 0 leaves it unchanged).
+                    recent = jax.vmap(
+                        lambda b, o: lax.dynamic_slice_in_dim(
+                            b, o, MAX_STOP_LEN, axis=0
+                        )
+                    )(buf2, counts_new)
                 toks = scatter_rows(toks, jnp.where(emit, sampled, pad_id), count)
                 lps = scatter_rows(lps, jnp.where(emit, lp_arr, 0.0), count)
                 if KT:
@@ -955,19 +1024,19 @@ class LocalEngine:
                         lambda nw, old: _sel(counts_new > 0, nw, old), new_jst, jst
                     )
                 count = count + counts_new
-                hit_eos_any = hit_eos_any | hit_eos
-                done = done | hit_eos | (count >= max_new)
+                hit_eos_any = hit_eos_any | hit_eos | stop_hit
+                done = done | hit_eos | stop_hit | (count >= max_new)
                 return (
                     it + 1, count, done, hit_eos_any, row_iters, cache, toks, lps,
-                    tt, tlb, vcounts, jst,
+                    tt, tlb, vcounts, jst, recent,
                 )
 
             state = (
                 jnp.int32(1), count0, done0, eos0,
                 jnp.zeros((B,), jnp.int32), gen_cache, toks, lps,
-                tt, tlb, vcounts0, jstate,
+                tt, tlb, vcounts0, jstate, recent0,
             )
-            _, count, _, hit_eos_any, row_iters, _, toks, lps, tt, tlb, _, _ = (
+            _, count, _, hit_eos_any, row_iters, _, toks, lps, tt, tlb, _, _, _ = (
                 lax.while_loop(cond, body, state)
             )
             return (
@@ -996,6 +1065,8 @@ class LocalEngine:
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
         logit_bias: Optional[Dict[int, float]] = None,
+        stop_arr: Optional[jax.Array] = None,
+        use_stops: bool = False,
     ) -> GenerationResult:
         config = self.config
         first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
@@ -1006,11 +1077,13 @@ class LocalEngine:
             n, max_new_tokens, temperature, top_p, top_k, bucket,
             constraint, top_logprobs, frequency_penalty, presence_penalty,
             use_logit_bias=logit_bias is not None,
+            use_stops=use_stops,
         )
         toks, lps, hit_eos, count, row_iters, tt, tl = loop(
             self.params, prefix, prompt_buf, jnp.int32(prompt_len),
             first_logits, jax.random.key(seed), eos_arr,
             self._bias_array(logit_bias),
+            stop_arr if stop_arr is not None else self._stop_array(None)[0],
         )
         toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
             np.asarray,
@@ -1199,17 +1272,18 @@ class LocalEngine:
         self.spec_stats = {}
 
         # Prompt-lookup speculative decode (single-chip): composes with
-        # constraints, penalties, top_logprobs, and logit_bias (VERDICT r2 #4).
-        # Remaining fallbacks: a mesh (sharded batched loop only) and device
-        # stop sequences (windowed suffix match not modeled in the verify
-        # block yet — stop requests take the normal loop's device halt).
+        # constraints, penalties, top_logprobs, logit_bias (VERDICT r2 #4) and
+        # device stop sequences (windowed suffix match truncates the emitted
+        # run at the first in-block hit). Remaining fallback: a mesh (the
+        # sharded batched loop only).
         if self.speculative == "prompt_lookup":
-            if self.mesh is None and not use_stops:
+            if self.mesh is None:
                 return self._generate_speculative(
                     prompt_ids, prompt_len, bucket, n, max_new_tokens,
                     temperature, top_p, top_k, seed, eos_arr,
                     constraint, top_logprobs, frequency_penalty,
                     presence_penalty, logit_bias,
+                    stop_arr=stop_arr, use_stops=use_stops,
                 )
             # Explicit sentinel so operators can tell a served-by-normal-loop
             # request from zero draft acceptance (ADVICE r2).

@@ -102,8 +102,8 @@ def forward_sequence_parallel(
         q = q.reshape(B, S, config.num_heads, config.head_dim)
         k = k.reshape(B, S, config.num_kv_heads, config.head_dim)
         v = v.reshape(B, S, config.num_kv_heads, config.head_dim)
-        q = rope_embed(q, positions, config.rope_theta)
-        k = rope_embed(k, positions, config.rope_theta)
+        q = rope_embed(q, positions, config.rope_theta, config.rope_scaling)
+        k = rope_embed(k, positions, config.rope_theta, config.rope_scaling)
         cache_k = lax.with_sharding_constraint(k.astype(config.jax_dtype), kv_sharded)
         cache_v = lax.with_sharding_constraint(v.astype(config.jax_dtype), kv_sharded)
 

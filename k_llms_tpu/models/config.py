@@ -24,6 +24,12 @@ class ModelConfig:
     num_kv_heads: int
     head_dim: int
     rope_theta: float = 500000.0
+    # Llama-3.1/3.2-style frequency-dependent RoPE scaling:
+    # (factor, low_freq_factor, high_freq_factor, original_max_position).
+    # None = vanilla RoPE. Long wavelengths (past original_max/low_freq)
+    # divide by factor, short ones keep, the band between interpolates —
+    # matching HF's rope_type="llama3".
+    rope_scaling: "tuple[float, float, float, int] | None" = None
     rms_eps: float = 1e-5
     max_seq_len: int = 8192
     dtype: str = "bfloat16"
@@ -132,6 +138,8 @@ register_config(
         num_kv_heads=8,
         head_dim=64,
         rope_theta=500000.0,
+        # Llama-3.2 checkpoints ship rope_type="llama3" with factor 32.
+        rope_scaling=(32.0, 1.0, 4.0, 8192),
         max_seq_len=8192,
     )
 )

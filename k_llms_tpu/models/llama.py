@@ -146,10 +146,32 @@ def _moe_mlp(config: ModelConfig, layer: Params, h: jax.Array) -> jax.Array:
     return jnp.einsum("bseh,bse->bsh", expert_out, combine.astype(expert_out.dtype))
 
 
-def rope_embed(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def _rope_inv_freq(d: int, theta: float, scaling) -> jax.Array:
+    """Per-pair inverse frequencies, with optional llama3-style scaling
+    (HF rope_type="llama3"; Llama-3.1/3.2 checkpoints): wavelengths past
+    original_ctx/low_freq divide by ``factor``, short ones stay, the band
+    between interpolates smoothly."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if scaling is None:
+        return inv_freq
+    factor, low_freq_factor, high_freq_factor, orig_ctx = scaling
+    wavelen = 2.0 * math.pi / inv_freq
+    low_wavelen = orig_ctx / low_freq_factor
+    high_wavelen = orig_ctx / high_freq_factor
+    smooth = (orig_ctx / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    interpolated = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    scaled = jnp.where(wavelen > low_wavelen, inv_freq / factor, interpolated)
+    return jnp.where(wavelen < high_wavelen, inv_freq, scaled)
+
+
+def rope_embed(
+    x: jax.Array, positions: jax.Array, theta: float, scaling=None
+) -> jax.Array:
     """Rotary embedding. x: [B, S, heads, D], positions: [B, S]."""
     d = x.shape[-1]
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    inv_freq = _rope_inv_freq(d, theta, scaling)
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -248,8 +270,8 @@ def _block(
     k = k.reshape(B, Sq, config.num_kv_heads, config.head_dim)
     v = v.reshape(B, Sq, config.num_kv_heads, config.head_dim)
 
-    q = rope_embed(q, positions, config.rope_theta)
-    k = rope_embed(k, positions, config.rope_theta)
+    q = rope_embed(q, positions, config.rope_theta, config.rope_scaling)
+    k = rope_embed(k, positions, config.rope_theta, config.rope_scaling)
 
     cache_k, cache_v = kv
     if write_index is None:

@@ -193,6 +193,25 @@ def load_checkpoint(path: str, config: ModelConfig, dtype=None) -> Dict[str, Any
     return load_orbax(path)
 
 
+def _rope_scaling_from_hf(rs: Optional[dict]):
+    """HF rope_scaling dict -> our (factor, low, high, original_ctx) tuple.
+    Only rope_type="llama3" (Llama-3.1/3.2) is modeled; other types raise so a
+    checkpoint never silently runs with wrong frequencies."""
+    if not rs:
+        return None
+    kind = rs.get("rope_type") or rs.get("type")
+    if kind == "llama3":
+        return (
+            float(rs["factor"]),
+            float(rs.get("low_freq_factor", 1.0)),
+            float(rs.get("high_freq_factor", 4.0)),
+            int(rs.get("original_max_position_embeddings", 8192)),
+        )
+    if kind in ("default", None):
+        return None
+    raise ValueError(f"unsupported rope_scaling type {kind!r}")
+
+
 def config_from_hf(path: str) -> Optional[ModelConfig]:
     """Build a ModelConfig from an HF config.json, if present."""
     cfg_path = os.path.join(path, "config.json")
@@ -234,6 +253,7 @@ def config_from_hf(path: str) -> Optional[ModelConfig]:
         num_kv_heads=hf.get("num_key_value_heads", heads),
         head_dim=hf.get("head_dim", hidden // heads),
         rope_theta=hf.get("rope_theta", 500000.0),
+        rope_scaling=_rope_scaling_from_hf(hf.get("rope_scaling")),
         rms_eps=hf.get("rms_norm_eps", 1e-5),
         max_seq_len=min(hf.get("max_position_embeddings", 8192), 8192),
         bos_token_id=hf.get("bos_token_id", 128000),

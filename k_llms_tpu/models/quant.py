@@ -84,6 +84,7 @@ def int4_mesh_compatible(config, tp: int) -> bool:
         return False  # expert einsums have no sharded-int4 path
     shapes = dict(_dense_quant_shapes(config))
     shapes["lm_head"] = (config.hidden_size, config.vocab_size)
+    slow = []
     for key, (k, n) in shapes.items():
         ndim = 2 if key == "lm_head" else 3
         if not _int4_eligible_shape(ndim, k, n):
@@ -91,9 +92,66 @@ def int4_mesh_compatible(config, tp: int) -> bool:
         if key in _ROW_PARALLEL_KEYS:
             if k % (GROUP * tp):
                 return False
-        elif n % tp:
-            return False
+            local_k, local_n = k // tp, n
+        else:
+            if n % tp:
+                return False
+            local_k, local_n = k, n // tp
+        # Correct but slow: a local shard whose blocking misses the Pallas
+        # kernel's grid (K blocks of >=256, N blocks of >=128 — w4_matmul's
+        # _pick) takes the XLA dequant fallback — int4's HBM-traffic win
+        # evaporates for that weight. Surface it.
+        if local_k % 256 or local_n % 128:
+            slow.append((key, (local_k, local_n)))
+    if slow:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "int4 on model parallel=%d for %s: local shards %s miss the w4a16 "
+            "kernel blocking and will use the XLA dequant fallback (correct, "
+            "but without the 4-bit HBM-traffic win)",
+            tp,
+            config.name,
+            slow,
+        )
     return True
+
+
+def tree_has_q4(params: "Dict[str, Any]") -> bool:
+    """True when any quantized matmul leaf is stored int4 (pre-quantized
+    checkpoints keep their layout through quantize_weight_bits)."""
+    leaves = [params["layers"].get(k) for k in _QUANT_LAYER_KEYS]
+    leaves.append(params.get("lm_head"))
+    return any(isinstance(w, Q4Tensor) for w in leaves)
+
+
+def align_quantized_specs(
+    params: "Dict[str, Any]", qspecs: "Dict[str, Any]", pspecs: "Dict[str, Any]"
+) -> "Dict[str, Any]":
+    """Reconcile a spec tree with the ACTUAL layout of a pre-quantized params
+    tree: quantize_weight_bits keeps a checkpoint's stored QTensor/Q4Tensor
+    layout regardless of the requested bits, so out_shardings built from the
+    request alone would diverge in pytree structure and crash pjit."""
+
+    def reconcile(w, spec_node, weight_spec):
+        if isinstance(w, Q4Tensor) and not isinstance(spec_node, Q4Tensor):
+            return Q4Tensor(q=weight_spec, scale=weight_spec)
+        if isinstance(w, QTensor) and not isinstance(spec_node, QTensor):
+            parts = list(weight_spec)
+            if len(parts) >= 2:
+                parts[-2] = None
+            return QTensor(q=weight_spec, scale=P(*parts))
+        return spec_node
+
+    layers = dict(qspecs["layers"])
+    for key in _QUANT_LAYER_KEYS:
+        layers[key] = reconcile(
+            params["layers"].get(key), layers[key], pspecs["layers"][key]
+        )
+    out = dict(qspecs)
+    out["layers"] = layers
+    out["lm_head"] = reconcile(params.get("lm_head"), qspecs["lm_head"], pspecs["lm_head"])
+    return out
 
 
 def mark_int4_partitioning(params: "Dict[str, Any]", mesh) -> "Dict[str, Any]":

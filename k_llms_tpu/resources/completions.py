@@ -31,13 +31,25 @@ import os
 logger = logging.getLogger(__name__)
 
 
-def _attach_trace(result, trace: Trace):
+def _attach_trace(result, trace: Trace, backend=None):
     """Phase timings: logged at DEBUG always; attached to the response as a
     ``timings`` extension only when KLLMS_TRACE=1 (keeps the default wire
-    payload byte-identical to the reference contract)."""
+    payload byte-identical to the reference contract). With a local backend
+    the trace also carries the engine-side serving stats (speculative
+    acceptance/fallback mode, prefix-cache hit mix, scheduler coalescing) —
+    the numbers operators tune speculative/prefix/batch knobs against."""
     logger.debug("request timings: %s", trace.as_dict())
     if os.getenv("KLLMS_TRACE") == "1":
         result.timings = trace.as_dict()
+        engine = getattr(backend, "engine", None)
+        if engine is not None:
+            result.engine_stats = {
+                "spec": dict(engine.spec_stats),
+                "prefix_cache": dict(engine.prefix_cache_stats),
+                "scheduler": dict(getattr(backend, "scheduler").stats)
+                if hasattr(backend, "scheduler")
+                else None,
+            }
     return result
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -157,7 +169,7 @@ class Completions:
                 consensus_settings=settings,
                 llm_consensus_fn=self._wrapper.backend.llm_consensus,
             )
-        return _attach_trace(result, trace)
+        return _attach_trace(result, trace, self._wrapper.backend)
 
     def parse(
         self,
@@ -192,7 +204,7 @@ class Completions:
                 response_format=response_format,
                 llm_consensus_fn=self._wrapper.backend.llm_consensus,
             )
-        return _attach_trace(result, trace)
+        return _attach_trace(result, trace, self._wrapper.backend)
 
 
 class AsyncCompletions:

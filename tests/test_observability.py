@@ -42,6 +42,29 @@ def test_timings_attached_when_traced(monkeypatch):
     assert "consolidate" in resp.timings
 
 
+def test_engine_stats_attached_when_traced(monkeypatch):
+    """KLLMS_TRACE=1 on a local backend also surfaces the engine serving
+    stats operators tune speculative/prefix/batch knobs against."""
+    monkeypatch.setenv("KLLMS_TRACE", "1")
+    backend = TpuBackend(model="tiny", max_new_tokens=4)
+    client = KLLMs(backend=backend)
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="tiny", n=2, seed=1
+    )
+    stats = resp.engine_stats
+    assert set(stats) == {"spec", "prefix_cache", "scheduler"}
+    assert stats["prefix_cache"] == {"hits": 0, "partial_hits": 0, "misses": 0}
+    assert stats["scheduler"]["served"] >= 1
+
+    # fake backend has no engine: timings only, no engine_stats
+    fake = KLLMs(backend="fake", responses=[["a", "a"]])
+    r2 = fake.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", n=2
+    )
+    assert getattr(r2, "engine_stats", None) is None
+    assert r2.timings["sample"] >= 0
+
+
 def test_timings_absent_by_default(monkeypatch):
     monkeypatch.delenv("KLLMS_TRACE", raising=False)
     client = KLLMs(backend="fake", responses=[["a", "a"]])

@@ -302,3 +302,22 @@ def test_parity_gnarly_structures(seed, method):
     assert our_value == ref_value, f"consensus value diverged (seed={seed})"
     assert our_conf == ref_conf, f"likelihoods diverged (seed={seed})"
     assert our_map == ref_map, f"key mappings diverged (seed={seed})"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_headline_n32(seed):
+    """The reference-faithful DEFAULT path at the headline consensus size
+    (n in 24..32): exactly the regime where the greedy election fragments
+    clusters and support pruning drops rows — whatever the reference does
+    there (including the row drop) must be reproduced bit-for-bit, since the
+    fix is an opt-in knob (alignment_refinement_rounds), not a drift."""
+    rng = random.Random(31_000 + seed)
+    base = make_gnarly_record(rng)
+    n = rng.randint(24, 32)
+    samples = [perturb_gnarly(rng, base) for _ in range(n)]
+    our_aligned, our_value, our_conf, our_map = run_ours(samples, "levenshtein")
+    ref_aligned, ref_value, ref_conf, ref_map = run_reference(samples, "levenshtein")
+    assert our_aligned == ref_aligned, f"alignment diverged (seed={seed})"
+    assert our_value == ref_value, f"consensus value diverged (seed={seed})"
+    assert our_conf == ref_conf, f"likelihoods diverged (seed={seed})"
+    assert our_map == ref_map, f"key mappings diverged (seed={seed})"

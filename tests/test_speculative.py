@@ -228,15 +228,41 @@ def test_spec_composes_json_constraint(engines):
             _json.loads(text)
 
 
-def test_spec_still_falls_back_on_mesh_or_stops(engines):
-    """Remaining documented fallbacks: device stop sequences."""
-    _, spec = engines
-    r = spec.generate(
-        PROMPT, n=2, max_new_tokens=4, temperature=0.8, seed=5,
-        stop_sequences=[[int(PROMPT[0])]],
+def test_spec_composes_stop_sequences(engines):
+    """Device stop sequences run UNDER speculation: greedy output, lengths,
+    and finish reasons match the normal loop's on-device halt — including
+    stops that complete mid-draft-run."""
+    normal, spec = engines
+    # Find the greedy chain's 3rd token and stop on it: the stop triggers
+    # mid-generation deterministically.
+    chain = normal.generate(PROMPT, n=1, max_new_tokens=6, temperature=0.0, seed=4)
+    stop_tok = int(chain.tokens[0, 2])
+    kw = dict(
+        n=2, max_new_tokens=12, temperature=0.0, seed=4,
+        stop_sequences=[[stop_tok]],
     )
-    assert r.tokens.shape == (2, 4)
-    assert spec.spec_stats == {"mode": "fallback"}
+    r_n = normal.generate(PROMPT, **kw)
+    r_s = spec.generate(PROMPT, **kw)
+    _assert_spec_ran(spec)
+    np.testing.assert_array_equal(r_s.tokens, r_n.tokens)
+    np.testing.assert_array_equal(r_s.lengths, r_n.lengths)
+    assert r_s.finish_reasons == r_n.finish_reasons == ["stop", "stop"]
+
+
+def test_spec_stop_with_repetitive_prompt(engines):
+    """A repetitive prompt maximizes multi-token accepts, so the stop must be
+    caught inside an accepted draft run, not only at run boundaries."""
+    normal, spec = engines
+    prompt = [21, 22, 23, 24] * 12
+    chain = normal.generate(prompt, n=1, max_new_tokens=8, temperature=0.0, seed=9)
+    stop_pair = [int(chain.tokens[0, 3]), int(chain.tokens[0, 4])]
+    kw = dict(n=2, max_new_tokens=10, temperature=0.0, seed=9,
+              stop_sequences=[stop_pair])
+    r_n = normal.generate(prompt, **kw)
+    r_s = spec.generate(prompt, **kw)
+    _assert_spec_ran(spec)
+    np.testing.assert_array_equal(r_s.tokens, r_n.tokens)
+    np.testing.assert_array_equal(r_s.lengths, r_n.lengths)
 
 
 def test_backend_plumbs_speculative():

@@ -271,3 +271,31 @@ def test_int4_fmt_marker_roundtrip(tmp_path):
         np.asarray(restored["layers"]["w_down"].q),
         np.asarray(qp["layers"]["w_down"].q),
     )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_prequantized_checkpoint_layout_survives_mesh_init():
+    """A pre-quantized tree keeps its STORED layout through engine init on a
+    mesh even when the requested bits differ (quantize_weight_bits documents
+    layout preservation): int8 tree + quantize="int4" must not crash on a
+    spec-structure mismatch, and generation still works."""
+    from k_llms_tpu.engine.engine import LocalEngine
+    from k_llms_tpu.models import init_params
+    from k_llms_tpu.models.quant import QTensor, quantize_params
+    from k_llms_tpu.parallel.mesh import make_mesh
+
+    cfg = _int4_cfg()
+    int8_tree = quantize_params(init_params(cfg, jax.random.key(6)), bits=8)
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(cfg, params=int8_tree, mesh=mesh, quantize="int4")
+    assert isinstance(eng.params["layers"]["w_gate"], QTensor)  # stored layout kept
+    r = eng.generate([5, 6, 7], n=4, max_new_tokens=3, temperature=0.5, seed=2)
+    assert r.tokens.shape == (4, 3)
+
+    # And the inverse: stored int4 + requested int8 on a COMPATIBLE mesh keeps
+    # int4 leaves and marks them for the sharded kernel.
+    int4_tree = quantize_params(init_params(cfg, jax.random.key(7)), bits=4)
+    eng2 = LocalEngine(cfg, params=int4_tree, mesh=mesh, quantize="int8")
+    assert eng2.params["layers"]["w_gate"].part == "col"
+    r2 = eng2.generate([5, 6, 7], n=4, max_new_tokens=3, temperature=0.5, seed=2)
+    assert r2.tokens.shape == (4, 3)
