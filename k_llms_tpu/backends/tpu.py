@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -300,21 +301,30 @@ class TpuBackend(Backend):
             )
 
         digest = hashlib.md5(repr((request.messages, request.seed)).encode()).hexdigest()[:12]
-        return ChatCompletion.model_validate(
-            {
-                "id": f"chatcmpl-tpu-{digest}",
-                "choices": choices,
-                "created": int(time.time()),
-                "model": request.model or self.model_name,
-                "object": "chat.completion",
-                "system_fingerprint": f"k-llms-tpu/{self.model_name}",
-                "usage": {
-                    "prompt_tokens": result.prompt_len,
-                    "completion_tokens": completion_tokens,
-                    "total_tokens": result.prompt_len + completion_tokens,
-                },
+        payload: Dict[str, Any] = {
+            "id": f"chatcmpl-tpu-{digest}",
+            "choices": choices,
+            "created": int(time.time()),
+            "model": request.model or self.model_name,
+            "object": "chat.completion",
+            "system_fingerprint": f"k-llms-tpu/{self.model_name}",
+            "usage": {
+                "prompt_tokens": result.prompt_len,
+                "completion_tokens": completion_tokens,
+                "total_tokens": result.prompt_len + completion_tokens,
+            },
+        }
+        if os.getenv("KLLMS_TRACE") == "1":
+            # Engine serving stats captured AT GENERATION TIME for this
+            # request (result.spec_stats rides the GenerationResult, so a
+            # concurrent request can't overwrite it before tracing reads it);
+            # cache/scheduler counters are cumulative snapshots.
+            payload["engine_stats"] = {
+                "spec": dict(result.spec_stats or {}),
+                "prefix_cache": dict(self.engine.prefix_cache_stats),
+                "scheduler": dict(self.scheduler.stats),
             }
-        )
+        return ChatCompletion.model_validate(payload)
 
     def _generate_batched(
         self,

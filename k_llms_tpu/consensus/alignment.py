@@ -164,6 +164,26 @@ class _Group:
     source_lists: set = field(default_factory=set)
 
 
+def _index_medoid(indices: List[Index]) -> Index:
+    """Vectorized index-space medoid — bit-equal to running the primitive
+    similarity medoid over the (list_idx, pos) tuples, which is what the spec
+    prescribes for group-representative re-election but was a measured hot
+    spot (O(members^2) pure-Python pair sims on every join at n=32).
+
+    Per-position similarity collapses to: 1.0 iff |a-b| <= 0.01*max(|a|,|b|)
+    (math.isclose(rel_tol=0.01); covers equality and the both-zero falsy
+    rule), else the 1e-8 floor; the pair score is the positional mean and the
+    medoid is the argmax of nan-diagonal row means — np.argmax's first-hit
+    tie rule matching `_medoid_consensus` exactly.
+    """
+    arr = np.asarray(indices, dtype=np.float64)  # [M, 2]
+    a, b = arr[:, None, :], arr[None, :, :]
+    close = np.abs(a - b) <= 0.01 * np.maximum(np.abs(a), np.abs(b))
+    sim = np.where(close, 1.0, 1e-8).mean(axis=-1)
+    np.fill_diagonal(sim, np.nan)
+    return indices[int(np.argmax(np.nanmean(sim, axis=1)))]
+
+
 def _refinement_pass(
     table: ElementTable, groups: List[_Group], threshold: float
 ) -> Tuple[List[_Group], bool]:
@@ -213,16 +233,12 @@ def _elect_reference(
     ``threshold`` whose group has no element from its source list yet, else
     founds a new group. After each join the representative is re-elected as the
     medoid of the member INDEX TUPLES (an index-space medoid — the spec calls
-    the primitive consensus on the (list_idx, pos) pairs themselves) and the
-    group moves to the back of the scan order, mirroring the reference's
-    dict-key reinsertion. Groups under ``min_support_ratio`` are dropped;
-    survivors are ordered by (-support, representative index).
+    the primitive consensus on the (list_idx, pos) pairs themselves; computed
+    by the vectorized bit-equal ``_index_medoid``) and the group moves to the
+    back of the scan order, mirroring the reference's dict-key reinsertion.
+    Groups under ``min_support_ratio`` are dropped; survivors are ordered by
+    (-support, representative index).
     """
-    from .primitive import consensus_as_primitive
-    from .similarity import SimilarityScorer
-
-    medoid_scorer = SimilarityScorer(method="embeddings", embed_fn=None)
-    medoid_settings = ConsensusSettings()
     groups: List[_Group] = []
 
     for r in range(len(table)):
@@ -241,9 +257,7 @@ def _elect_reference(
             continue
         best.members.append(r)
         best.source_lists.add(src)
-        elected, _ = consensus_as_primitive(
-            [table.element(m) for m in best.members], medoid_settings, medoid_scorer
-        )
+        elected = _index_medoid([table.element(m) for m in best.members])
         elected_row = table.row(elected)
         if elected_row != best.rep:
             best.rep = elected_row

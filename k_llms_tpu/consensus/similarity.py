@@ -17,6 +17,7 @@ in deterministic fakes. The reference's module-global TTL caches become per-scor
 
 from __future__ import annotations
 
+import functools
 import logging
 import math
 import re
@@ -37,6 +38,13 @@ logger = logging.getLogger(__name__)
 EmbeddingFn = Callable[[List[str]], List[List[float]]]
 
 NumericalPrimitive = (int, float)
+
+
+@functools.lru_cache(maxsize=4096)
+def _key_ignored(k: str) -> bool:
+    """Memoized reasoning___/source___ key-skip check: dict similarity runs it
+    per key per PAIR, which made re.match a measured hot spot at n=32."""
+    return any(re.match(p, k) for p in IGNORED_KEY_PATTERNS)
 
 # Embeddings are only worth the trip for long strings (reference :813).
 EMBEDDING_MIN_CHARS = 50
@@ -157,9 +165,7 @@ class SimilarityScorer:
         # differently run to run and downstream threshold/medoid decisions
         # flip (the reference has this instability; determinism wins here).
         all_keys = sorted(set(d1.keys()) | set(d2.keys()))
-        all_keys = [
-            k for k in all_keys if not any(re.match(p, k) for p in IGNORED_KEY_PATTERNS)
-        ]
+        all_keys = [k for k in all_keys if not _key_ignored(k)]
         if not all_keys:
             return 1.0
         total = 0.0

@@ -121,6 +121,10 @@ class GenerationResult(NamedTuple):
     # under the untempered model distribution (OpenAI `top_logprobs`).
     top_tokens: Optional[np.ndarray] = None  # [n, max_new, k] int32
     top_logprobs: Optional[np.ndarray] = None  # [n, max_new, k] f32
+    # THIS request's speculative-decoding stats, captured at generation time
+    # (engine.spec_stats mirrors the most recent request for convenience, but
+    # is shared mutable state — concurrent tracing must read this field).
+    spec_stats: Optional[Dict[str, Any]] = None
 
 
 class GenRequestSpec(NamedTuple):
@@ -164,14 +168,32 @@ class LocalEngine:
         self.mesh = mesh
         if quantize is True:
             quantize = "int8"
-        if quantize == "int4" and mesh is not None:
-            # int4 on a mesh runs the w4a16 kernel shard_mapped over the model
-            # axis (ops/w4matmul.py::w4_matmul_tp) — possible whenever no
-            # quantization group would split across devices; otherwise int8
-            # (XLA-native, partitionable) is the fallback.
-            from ..models.quant import int4_mesh_compatible
+        int4_mesh_ok: Optional[bool] = None  # evaluated at most once per init
+        if mesh is not None and quantize:
+            from ..models.quant import int4_mesh_compatible, tree_has_q4
 
-            if not int4_mesh_compatible(self.config, mesh.shape.get(MODEL_AXIS, 1)):
+            # A supplied PRE-quantized int4 tree keeps its stored layout
+            # through quantize_weight_bits, so mesh compatibility must be
+            # checked BEFORE the sharded quantize/put — otherwise pjit fails
+            # first with an opaque dimension-not-divisible error (and, with
+            # quantize="int4", a misleading int8-downgrade warning).
+            stored_q4 = params is not None and tree_has_q4(params)
+            if quantize == "int4" or stored_q4:
+                int4_mesh_ok = int4_mesh_compatible(
+                    self.config, mesh.shape.get(MODEL_AXIS, 1)
+                )
+            if stored_q4 and not int4_mesh_ok:
+                raise ValueError(
+                    f"checkpoint stores int4 weights whose quantization groups "
+                    f"cannot shard over model parallel="
+                    f"{mesh.shape.get(MODEL_AXIS, 1)} for {self.config.name}; "
+                    "re-quantize to int8 or change the mesh"
+                )
+            if quantize == "int4" and not stored_q4 and not int4_mesh_ok:
+                # int4 on a mesh runs the w4a16 kernel shard_mapped over the
+                # model axis (ops/w4matmul.py::w4_matmul_tp) — possible
+                # whenever no quantization group would split across devices;
+                # otherwise int8 (XLA-native, partitionable) is the fallback.
                 logger.warning(
                     "int4 shards don't align with model parallel=%s for %s; using int8",
                     mesh.shape.get(MODEL_AXIS, 1),
@@ -224,21 +246,11 @@ class LocalEngine:
             # Mark every int4 leaf with its TP layout — whatever its origin
             # (fresh int4 init, or a pre-quantized checkpoint whose stored
             # int4 layout survives an int8 request). An unmarked Q4Tensor on a
-            # mesh would hand GSPMD an unpartitionable pallas call.
-            from ..models.quant import (
-                int4_mesh_compatible,
-                mark_int4_partitioning,
-                tree_has_q4,
-            )
+            # mesh would hand GSPMD an unpartitionable pallas call. Mesh
+            # compatibility was already enforced above, before any sharded put.
+            from ..models.quant import mark_int4_partitioning, tree_has_q4
 
             if tree_has_q4(params):
-                if not int4_mesh_compatible(self.config, mesh.shape.get(MODEL_AXIS, 1)):
-                    raise ValueError(
-                        f"checkpoint stores int4 weights whose quantization "
-                        f"groups cannot shard over model parallel="
-                        f"{mesh.shape.get(MODEL_AXIS, 1)} for {self.config.name}; "
-                        "re-quantize to int8 or change the mesh"
-                    )
                 params = mark_int4_partitioning(params, self.mesh)
         self.params = params
 
@@ -274,7 +286,8 @@ class LocalEngine:
         self.prefix_cache_min_reuse = prefix_cache_min_reuse
         from collections import OrderedDict
 
-        # value: (first_logits, prefix KVCache, prompt_len, np.int32 token ids)
+        # value: (first_logits, prefix KVCache, prompt_len, np.int32 token ids,
+        #         seq_sharded — sp_decode entries are exact-hit-only)
         self._prefix_entries: "OrderedDict[Tuple[int, ...], Tuple[Any, KVCache, int, Any]]" = (
             OrderedDict()
         )
@@ -422,10 +435,12 @@ class LocalEngine:
             self._continue_cache[key] = fn
         return fn
 
-    def _prefix_store(self, ids: List[int], first_logits, prefix: KVCache) -> None:
+    def _prefix_store(
+        self, ids: List[int], first_logits, prefix: KVCache, seq_sharded: bool = False
+    ) -> None:
         key = tuple(ids)
         self._prefix_entries[key] = (
-            first_logits, prefix, len(ids), np.asarray(ids, np.int32)
+            first_logits, prefix, len(ids), np.asarray(ids, np.int32), seq_sharded
         )
         self._prefix_entries.move_to_end(key)
         while len(self._prefix_entries) > self.prefix_cache_size:
@@ -435,10 +450,17 @@ class LocalEngine:
         """Longest common token prefix across cached prompts (vectorized —
         long prompts are exactly the cache's target workload). Returns the
         matched entry's KV and the usable common length (capped below the new
-        prompt's length so there is always >=1 suffix token to prefill)."""
+        prompt's length so there is always >=1 suffix token to prefill).
+
+        Sequence-sharded entries (sp_decode) are exact-hit-only: the
+        replicated continuation prefill padding/slicing one would all-gather
+        the full O(S) prefix onto every device — the exact HBM spike the
+        sp_decode layout exists to avoid at long contexts."""
         ids_np = np.asarray(ids, np.int32)
         best_kv, best_p = None, 0
-        for _, kv, plen, arr in self._prefix_entries.values():
+        for _, kv, plen, arr, seq_sharded in self._prefix_entries.values():
+            if seq_sharded:
+                continue
             limit = min(len(ids) - 1, plen)
             neq = np.flatnonzero(arr[:limit] != ids_np[:limit])
             p = int(neq[0]) if neq.size else limit
@@ -1097,12 +1119,13 @@ class LocalEngine:
         ri = iters_np[:n]
         rates = (count_np[:n] - 1.0) / np.maximum(ri, 1)
         ran = ri > 0
-        self.spec_stats = {
+        spec_stats = {
             "verify_iterations": int(ri.max(initial=0)),
             "tokens_per_iteration": (
                 round(float(rates[ran].mean()), 3) if ran.any() else None
             ),
         }
+        self.spec_stats = spec_stats
         # Same length convention as the normal loop: count non-pad tokens, so
         # a pad-mapped-to-eos stop token is excluded identically in both modes
         # (emitted tokens are otherwise never pad — pad is masked at sampling).
@@ -1115,6 +1138,7 @@ class LocalEngine:
             prompt_len=prompt_len,
             top_tokens=tt_np[:n] if top_logprobs else None,
             top_logprobs=tl_np[:n] if top_logprobs else None,
+            spec_stats=spec_stats,
         )
 
     def _stop_array(
@@ -1269,7 +1293,9 @@ class LocalEngine:
 
         # Stats describe THIS request only — a fallback to the normal loop
         # must not leave a previous speculative request's numbers visible.
-        self.spec_stats = {}
+        # (kept local + threaded into the result; self.spec_stats mirrors it.)
+        spec_stats: Dict[str, Any] = {}
+        self.spec_stats = spec_stats
 
         # Prompt-lookup speculative decode (single-chip): composes with
         # constraints, penalties, top_logprobs, logit_bias (VERDICT r2 #4) and
@@ -1287,21 +1313,36 @@ class LocalEngine:
                 )
             # Explicit sentinel so operators can tell a served-by-normal-loop
             # request from zero draft acceptance (ADVICE r2).
-            self.spec_stats = {"mode": "fallback"}
+            spec_stats = {"mode": "fallback"}
+            self.spec_stats = spec_stats
 
         req_keys = jnp.stack([jax.random.key(seed)])
 
         # Ring-decode route (sp_decode): prompts taking the SP prefill keep
-        # their KV sequence-sharded and decode against it in place. The prefix
-        # cache is bypassed for these — its entries (and the continuation
-        # prefill) use the replicated layout.
+        # their KV sequence-sharded and decode against it in place. Exact
+        # prefix-cache hits compose (the cached seq-sharded KV feeds the ring
+        # loop directly); partial-hit CONTINUATION does not — the suffix
+        # prefill writes into the replicated layout — so repeats re-prefill
+        # sequence-parallel instead.
         sp_resident = (
             self.sp_decode
             and self.mesh is not None
             and self._use_sp_prefill(prompt_len, bucket)
         )
         if sp_resident:
-            first_logits, prefix = self._prefill_full(prompt_ids, prompt_len, bucket)
+            key = tuple(prompt_ids)
+            hit = self._prefix_entries.get(key) if self.prefix_cache_size else None
+            if hit is not None:
+                self._prefix_entries.move_to_end(key)
+                self.prefix_cache_stats["hits"] += 1
+                first_logits, prefix = hit[0], hit[1]
+            else:
+                first_logits, prefix = self._prefill_full(prompt_ids, prompt_len, bucket)
+                if self.prefix_cache_size:
+                    self.prefix_cache_stats["misses"] += 1
+                    self._prefix_store(
+                        prompt_ids, first_logits, prefix, seq_sharded=True
+                    )
         else:
             first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
         loop = self._get_decode_loop(
@@ -1342,6 +1383,7 @@ class LocalEngine:
             prompt_len=prompt_len,
             top_tokens=np.asarray(tt_np)[:n] if top_logprobs else None,
             top_logprobs=np.asarray(tl_np)[:n] if top_logprobs else None,
+            spec_stats=spec_stats,
         )
 
     def generate_many(
@@ -1399,11 +1441,13 @@ class LocalEngine:
         eos_arr = jnp.array(eos + [-1] * (MAX_EOS_IDS - len(eos)), jnp.int32)
         self._validate_constraint(constraint, eos)
 
+        many_spec_stats: Dict[str, Any] = {}
         if self.speculative:
             # Coalesced bursts take the normal batched loop; the sentinel keeps
             # that visible (admission-window coalescing would otherwise silently
             # drop speculation for concurrent extraction bursts — ADVICE r2).
-            self.spec_stats = {"mode": "coalesced_fallback"}
+            many_spec_stats = {"mode": "coalesced_fallback"}
+        self.spec_stats = many_spec_stats
 
         preps = [self._prep_prompt(it.prompt_ids) for it in items]
         bucket_max = max(bucket for _, _, bucket in preps)
@@ -1497,6 +1541,7 @@ class LocalEngine:
                     prompt_len=prompt_len,
                     top_tokens=tt_np[lo : lo + n_j] if top_logprobs else None,
                     top_logprobs=tl_np[lo : lo + n_j] if top_logprobs else None,
+                    spec_stats=many_spec_stats,
                 )
             )
         return results

@@ -98,10 +98,13 @@ def int4_mesh_compatible(config, tp: int) -> bool:
                 return False
             local_k, local_n = k, n // tp
         # Correct but slow: a local shard whose blocking misses the Pallas
-        # kernel's grid (K blocks of >=256, N blocks of >=128 — w4_matmul's
-        # _pick) takes the XLA dequant fallback — int4's HBM-traffic win
-        # evaporates for that weight. Surface it.
-        if local_k % 256 or local_n % 128:
+        # kernel's grid takes the XLA dequant fallback — int4's HBM-traffic
+        # win evaporates for that weight. Surface it. (Divisibility by ANY
+        # block choice == divisibility by the smallest, since the choices are
+        # multiples of it — single source of truth in ops/w4matmul.py.)
+        from ..ops.w4matmul import KERNEL_K_BLOCKS, KERNEL_N_BLOCKS
+
+        if local_k % min(KERNEL_K_BLOCKS) or local_n % min(KERNEL_N_BLOCKS):
             slow.append((key, (local_k, local_n)))
     if slow:
         import logging

@@ -149,6 +149,13 @@ def _w4_kernel(x_ref, qp_ref, sc_ref, o_ref, acc_ref, *, groups: int, out_dtype)
         o_ref[:] = acc_ref[:].astype(out_dtype)
 
 
+# Kernel grid blocking choices (largest-first; _pick takes the first that
+# divides). int4_mesh_compatible derives its slow-shard advisory from these,
+# so changing them here keeps the two in sync.
+KERNEL_K_BLOCKS = (1024, 512, 256)
+KERNEL_N_BLOCKS = (512, 256, 128)
+
+
 def _pick(total: int, choices) -> int:
     for c in choices:
         if total % c == 0:
@@ -170,8 +177,8 @@ def w4_matmul(
     Kh, N = w.q.shape
     assert K == Kh * 2, (K, w.q.shape)
 
-    block_k = _pick(K, (1024, 512, 256))
-    block_n = _pick(N, (512, 256, 128))
+    block_k = _pick(K, KERNEL_K_BLOCKS)
+    block_n = _pick(N, KERNEL_N_BLOCKS)
     if not block_k or not block_n:
         return (x.astype(jnp.float32) @ unpack_int4(w)).astype(x.dtype)
 

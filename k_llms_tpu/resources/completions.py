@@ -41,15 +41,21 @@ def _attach_trace(result, trace: Trace, backend=None):
     logger.debug("request timings: %s", trace.as_dict())
     if os.getenv("KLLMS_TRACE") == "1":
         result.timings = trace.as_dict()
-        engine = getattr(backend, "engine", None)
-        if engine is not None:
-            result.engine_stats = {
-                "spec": dict(engine.spec_stats),
-                "prefix_cache": dict(engine.prefix_cache_stats),
-                "scheduler": dict(getattr(backend, "scheduler").stats)
-                if hasattr(backend, "scheduler")
-                else None,
-            }
+        # TpuBackend attaches engine_stats to the completion payload at
+        # generation time (race-free under concurrency: the spec stats ride
+        # the GenerationResult, not shared engine state) and the wire types'
+        # extra="allow" carries them through consolidation. Fall back to a
+        # live engine snapshot only for backends that don't attach them.
+        if getattr(result, "engine_stats", None) is None:
+            engine = getattr(backend, "engine", None)
+            if engine is not None:
+                result.engine_stats = {
+                    "spec": dict(engine.spec_stats),
+                    "prefix_cache": dict(engine.prefix_cache_stats),
+                    "scheduler": dict(getattr(backend, "scheduler").stats)
+                    if hasattr(backend, "scheduler")
+                    else None,
+                }
     return result
 
 if TYPE_CHECKING:  # pragma: no cover

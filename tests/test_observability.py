@@ -91,3 +91,22 @@ def test_initialize_multihost_noop_single_host(monkeypatch):
     monkeypatch.delenv("KLLMS_COORDINATOR", raising=False)
     monkeypatch.delenv("KLLMS_NUM_PROCESSES", raising=False)
     assert initialize_multihost() is False
+
+
+def test_engine_stats_captured_at_generation_time(monkeypatch):
+    """Traced responses carry the spec stats captured for THIS request (via
+    GenerationResult), so a concurrent request mutating engine.spec_stats
+    after generation cannot contaminate the trace."""
+    monkeypatch.setenv("KLLMS_TRACE", "1")
+    backend = TpuBackend(model="tiny", max_new_tokens=4, speculative="prompt_lookup")
+    client = KLLMs(backend=backend)
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q q q q"}], model="tiny", n=2, seed=1
+    )
+    captured = dict(resp.engine_stats["spec"])
+    # under the 8-device test mesh the spec path falls back (mesh gate); the
+    # capture must reflect THIS request's actual mode either way
+    assert captured in ({"mode": "fallback"},) or "verify_iterations" in captured
+    # simulate a concurrent request overwriting the shared engine field
+    backend.engine.spec_stats = {"verify_iterations": 999}
+    assert resp.engine_stats["spec"] == captured  # trace unaffected

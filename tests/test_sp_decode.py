@@ -113,3 +113,44 @@ def test_short_prompts_keep_replicated_path(engines):
     np.testing.assert_array_equal(
         ring.generate(short, **kw).tokens, dense.generate(short, **kw).tokens
     )
+
+
+def test_sp_decode_composes_with_prefix_cache_exact_hits():
+    """Exact repeats of an SP-resident prompt reuse the cached seq-sharded KV
+    (no re-prefill) and reproduce the same generation."""
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True, prefix_cache_size=2,
+    )
+    kw = dict(n=4, max_new_tokens=4, temperature=0.7, seed=13)
+    r1 = eng.generate(PROMPT, **kw)
+    assert eng.prefix_cache_stats == {"hits": 0, "partial_hits": 0, "misses": 1}
+    r2 = eng.generate(PROMPT, **kw)
+    assert eng.prefix_cache_stats["hits"] == 1
+    np.testing.assert_array_equal(r1.tokens, r2.tokens)
+
+
+def test_seq_sharded_cache_entry_never_partial_matches():
+    """A seq-sharded (sp_decode) cache entry must be exact-hit-only: a shorter
+    prompt sharing its prefix takes a full prefill (miss), never the
+    replicated continuation that would all-gather the O(S) prefix."""
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True,
+        prefix_cache_size=2, prefix_cache_min_reuse=16,
+    )
+    eng.generate(PROMPT, n=4, max_new_tokens=2, temperature=0.5, seed=1)
+    assert eng.prefix_cache_stats["misses"] == 1
+    # Shorter prompt sharing a >=16-token prefix: below the SP threshold, so
+    # it routes through the replicated cache path — which must NOT partial-hit
+    # the seq-sharded entry.
+    short = PROMPT[:20]
+    eng.generate(short, n=2, max_new_tokens=2, temperature=0.5, seed=2)
+    assert eng.prefix_cache_stats["partial_hits"] == 0
+    assert eng.prefix_cache_stats["misses"] == 2

@@ -299,3 +299,21 @@ def test_prequantized_checkpoint_layout_survives_mesh_init():
     assert eng2.params["layers"]["w_gate"].part == "col"
     r2 = eng2.generate([5, 6, 7], n=4, max_new_tokens=3, temperature=0.5, seed=2)
     assert r2.tokens.shape == (4, 3)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_stored_int4_incompatible_mesh_raises_before_pjit():
+    """A pre-quantized int4 tree whose groups cannot shard over the model axis
+    must fail with the clear ValueError BEFORE the sharded quantize/put (which
+    would otherwise die inside pjit with an opaque sharding error)."""
+    from k_llms_tpu.engine.engine import LocalEngine
+    from k_llms_tpu.models import init_params
+    from k_llms_tpu.models.quant import quantize_params
+    from k_llms_tpu.parallel.mesh import make_mesh
+
+    cfg = _int4_cfg()  # K=256 row weights: groups split at tp=4
+    int4_tree = quantize_params(init_params(cfg, jax.random.key(9)), bits=4)
+    with pytest.raises(ValueError, match="re-quantize to int8 or change the mesh"):
+        LocalEngine(cfg, params=int4_tree, mesh=make_mesh(2, 4), quantize="int4")
+    with pytest.raises(ValueError, match="re-quantize to int8 or change the mesh"):
+        LocalEngine(cfg, params=int4_tree, mesh=make_mesh(2, 4), quantize="int8")
