@@ -22,7 +22,6 @@ import numpy as np
 
 from ..native import linear_sum_assignment
 from .majority import _original_positions, sort_by_original_majority
-from .settings import ConsensusSettings
 
 logger = logging.getLogger(__name__)
 

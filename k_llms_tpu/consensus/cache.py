@@ -11,7 +11,7 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 from threading import Lock
-from typing import Any, Hashable, Optional
+from typing import Any, Hashable
 
 
 class TTLCache:

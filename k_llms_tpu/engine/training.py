@@ -11,7 +11,7 @@ ICI. Also the program exercised by ``__graft_entry__.dryrun_multichip``.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

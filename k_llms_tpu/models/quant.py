@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.w4matmul import Q4Tensor, pack_int4, supports_int4, unpack_int4, w4_matmul
+from ..ops.w4matmul import Q4Tensor, pack_int4, supports_int4, w4_matmul
 
 
 class QTensor(NamedTuple):
