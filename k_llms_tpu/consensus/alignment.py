@@ -61,16 +61,20 @@ class ElementTable:
         self._starts = np.cumsum([0] + [len(lst) for lst in self.lists])
 
         n = len(flat)
-        sim = np.ones((n, n))
+        sim = None
         if anchor_list is None:
-            for a in range(n):
-                for b in range(a + 1, n):
-                    sim[a, b] = sim[b, a] = sim_fn(flat[a], flat[b])
-        else:
-            for a in self.rows_of(anchor_list):
-                for b in range(n):
-                    if b != a:
+            sim = _flat_dict_sim_matrix(flat, sim_fn)
+        if sim is None:
+            sim = np.ones((n, n))
+            if anchor_list is None:
+                for a in range(n):
+                    for b in range(a + 1, n):
                         sim[a, b] = sim[b, a] = sim_fn(flat[a], flat[b])
+            else:
+                for a in self.rows_of(anchor_list):
+                    for b in range(n):
+                        if b != a:
+                            sim[a, b] = sim[b, a] = sim_fn(flat[a], flat[b])
         self.sim = sim
 
     def __len__(self) -> int:
@@ -92,6 +96,77 @@ class ElementTable:
 
 # Backwards-compatible alias: earlier revisions exposed the memo under this name.
 SimilarityCache = ElementTable
+
+
+def _flat_dict_sim_matrix(flat, sim_fn) -> Optional[np.ndarray]:
+    """Vectorized dense similarity matrix for the COMMON alignment shape —
+    every element a flat dict of scalar values (extraction rows) scored by a
+    SimilarityScorer.generic — bit-equal to the pairwise loop it replaces:
+
+    - per-pair key union and the reasoning___/source___ skip commute with the
+      global sorted key set (absent keys contribute exact 0.0 terms, which
+      never change left-to-right float accumulation);
+    - each UNIQUE (value, value) pair is still scored by the scorer itself
+      (same string caches, same numerics), just once instead of per pair;
+    - an all-keys-skipped pair scores 1.0, exactly like ``scorer.dict``.
+
+    Returns None (fall back to the generic loop) for non-dict or nested
+    elements, foreign sim_fns, or degenerate shapes.
+    """
+    n = len(flat)
+    if n < 3:
+        return None  # nothing to win
+    scorer = getattr(sim_fn, "__self__", None)
+    from .similarity import SimilarityScorer, _key_ignored
+
+    if not isinstance(scorer, SimilarityScorer) or getattr(sim_fn, "__name__", "") != "generic":
+        return None
+    if not all(type(x) is dict for x in flat):
+        return None
+    for d in flat:
+        if not d:
+            return None  # empty dicts hit the falsy rule, not dict()
+        for v in d.values():
+            if isinstance(v, (dict, list, tuple)):
+                return None
+    keys = sorted({k for d in flat for k in d})
+    keys = [k for k in keys if not _key_ignored(k)]
+    if not keys or len(keys) > 64:
+        return None
+
+    totals = np.zeros((n, n))
+    denom = np.zeros((n, n))
+    missing = object()
+    for key in keys:
+        present = np.array([key in d for d in flat])
+        union = present[:, None] | present[None, :]
+        vals = [d.get(key) for d in flat]
+        mapping: dict = {}
+        idx = np.empty(n, np.int64)
+        uniq: list = []
+        try:
+            for i, v in enumerate(vals):
+                mk = (type(v).__name__, v if v == v else missing)  # NaN-safe key
+                j = mapping.get(mk)
+                if j is None:
+                    j = mapping[mk] = len(uniq)
+                    uniq.append(v)
+                idx[i] = j
+        except TypeError:
+            return None  # unhashable exotic value — generic loop handles it
+        u = len(uniq)
+        usim = np.empty((u, u))
+        for i in range(u):
+            usim[i, i] = sim_fn(uniq[i], uniq[i])
+            for j in range(i + 1, u):
+                usim[i, j] = usim[j, i] = sim_fn(uniq[i], uniq[j])
+        simk = usim[np.ix_(idx, idx)]
+        totals += np.where(union, simk, 0.0)
+        denom += union
+
+    sim = np.where(denom > 0, totals / np.maximum(denom, 1.0), 1.0)
+    np.fill_diagonal(sim, 1.0)
+    return sim
 
 
 def low_cutoff_bound(scores) -> float:
