@@ -289,6 +289,47 @@ def bench_quality() -> dict:
     }
 
 
+def bench_host_consensus() -> dict:
+    """Host-side consolidation latency at the headline n=32 (hermetic, no
+    device): the consensus stage every request pays after decode. Runs cold
+    (fresh similarity caches per request — the worst case) and warm (shared
+    per-backend scorer, the production configuration)."""
+    from k_llms_tpu.consensus.consolidation import consolidate_chat_completions
+    from k_llms_tpu.consensus.similarity import SimilarityScorer
+    from k_llms_tpu.types import ChatCompletion
+    from k_llms_tpu.utils.quality import DEFAULT_TRUTH, make_noisy_samples
+
+    samples = make_noisy_samples(DEFAULT_TRUTH, N_CONSENSUS, 0.15, 7)
+    comp = ChatCompletion.model_validate(
+        {
+            "id": "c", "created": 0, "model": "m", "object": "chat.completion",
+            "choices": [
+                {
+                    "finish_reason": "stop",
+                    "index": i,
+                    "message": {"role": "assistant", "content": s},
+                }
+                for i, s in enumerate(samples)
+            ],
+        }
+    )
+    shared = SimilarityScorer.levenshtein()
+    consolidate_chat_completions(comp, shared)  # warm the shared scorer
+
+    def timed(fresh: bool, reps: int = 15) -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            scorer = SimilarityScorer.levenshtein() if fresh else shared
+            consolidate_chat_completions(comp, scorer)
+        return (time.perf_counter() - t0) / reps * 1000.0
+
+    return {
+        "n": N_CONSENSUS,
+        "cold_ms": round(timed(True), 2),
+        "warm_ms": round(timed(False), 2),
+    }
+
+
 def _emit(value, vs_baseline, detail: dict, error: "str | None" = None) -> None:
     line = {
         "metric": "n32_consensus_p50_over_single_p50",
@@ -308,6 +349,10 @@ def main() -> None:
         detail["quality"] = bench_quality()
     except Exception as exc:  # quality is hermetic; a failure here is a bug
         detail["quality"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        detail["host_consensus"] = bench_host_consensus()
+    except Exception as exc:
+        detail["host_consensus"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     last_error = None
     for attempt in range(1, RUN_RETRIES + 2):
