@@ -268,3 +268,56 @@ def test_batch_window_skipped_when_budget_exhausted():
     assert out == 6
     assert _time.perf_counter() - t0 < 2.0
     sched.shutdown()
+
+
+def test_concurrent_traced_requests_keep_their_own_spec_stats(monkeypatch):
+    """Two concurrent traced requests must each carry their OWN generation-time
+    engine stats even though they share one engine (the regression the
+    GenerationResult.spec_stats threading exists to prevent)."""
+    from k_llms_tpu import KLLMs
+    from k_llms_tpu.backends.tpu import TpuBackend
+    from k_llms_tpu.engine.engine import GenRequestSpec
+
+    monkeypatch.setenv("KLLMS_TRACE", "1")
+    backend = TpuBackend(
+        model="tiny", max_new_tokens=4, speculative="prompt_lookup"
+    )
+    client = KLLMs(backend=backend)
+
+    def one(i, out):
+        out[i] = client.chat.completions.create(
+            messages=[{"role": "user", "content": f"req {i}"}],
+            model="tiny", n=2, seed=200 + i,
+        )
+
+    warm: dict = {}
+    one(0, warm)  # compile the solo program shape
+    tok = backend.tokenizer
+    warm_ids = tok.apply_chat_template(
+        [{"role": "user", "content": "req 0"}], add_generation_prompt=True
+    )
+    for r in (2, 4):  # compile the coalesced shapes a 3-thread race can hit
+        backend.engine.generate_many(
+            [GenRequestSpec(warm_ids, 2, i) for i in range(r)],
+            max_new_tokens=backend.default_max_new_tokens,
+            eos_ids=tok.stop_ids,
+        )
+
+    results: dict = {}
+    threads = [threading.Thread(target=one, args=(i, results)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, resp in results.items():
+        stats = resp.engine_stats
+        assert set(stats) == {"spec", "prefix_cache", "scheduler"}
+        spec = stats["spec"]
+        # Each request's spec stats must be a VALID generation-time value for
+        # that request: the spec loop's acceptance numbers (solo-served, mesh
+        # permitting), or a fallback sentinel. A shared-state read racing
+        # another request's reset would surface as {} here.
+        assert (
+            "verify_iterations" in spec
+            or spec.get("mode") in ("fallback", "coalesced_fallback")
+        ), spec

@@ -158,3 +158,33 @@ def test_stop_rows_halt_independently(backend):
         stop_sequences=[[250]],  # a byte the random model rarely emits
     )
     assert (np.asarray(out.lengths) > 0).all()
+
+
+def test_stop_window_match_properties():
+    """Direct properties of the shared matcher: padding wildcards, dead rows,
+    multi-sequence OR, and exact right-alignment."""
+    import jax.numpy as jnp
+
+    from k_llms_tpu.engine.engine import stop_window_match
+
+    stops = jnp.array(
+        [
+            [-1, -1, -1, -1, -1, -1, 7, 9],   # 2-token stop [7, 9]
+            [-1, -1, -1, -1, -1, -1, -1, 4],  # 1-token stop [4]
+            [-1, -1, -1, -1, -1, -1, -1, -1], # inactive row
+            [-1, -1, -1, -1, -1, -1, -1, -1],
+        ],
+        jnp.int32,
+    )
+    win = jnp.array(
+        [
+            [1, 2, 3, 4, 5, 6, 7, 9],   # ends with [7, 9] -> hit
+            [1, 2, 3, 4, 5, 6, 9, 7],   # wrong order -> miss
+            [1, 2, 3, 4, 5, 6, 7, 4],   # ends with 4 -> hit (second stop)
+            [7, 9, 3, 4, 5, 6, 1, 2],   # stop NOT at the suffix -> miss
+            [-1, -1, -1, -1, -1, -1, -1, -1],  # fresh row: all -1 sentinel
+        ],
+        jnp.int32,
+    )
+    got = [bool(x) for x in stop_window_match(win, stops)]
+    assert got == [True, False, True, False, False]
