@@ -158,11 +158,18 @@ def run_ours(samples, method):
     return _normalize(aligned), _normalize(value), _normalize(conf), mappings
 
 
-@pytest.mark.parametrize("method", ["levenshtein", "embeddings", "jaccard", "hamming"])
-@pytest.mark.parametrize("seed", range(25))
+# Full 25-seed budget for the default method; 10 seeds apiece for the rest
+# (structural, so a healthy run reports ZERO skips — a skip in the summary
+# always means something environmental went wrong).
+PARITY_CASES = [
+    (seed, method)
+    for method in ("levenshtein", "embeddings", "jaccard", "hamming")
+    for seed in range(25 if method == "levenshtein" else 10)
+]
+
+
+@pytest.mark.parametrize("seed,method", PARITY_CASES)
 def test_parity_random_structures(seed, method):
-    if method != "levenshtein" and seed >= 10:
-        pytest.skip("reduced seed budget for non-default methods")
     samples = make_samples(seed)
     ref_aligned, ref_value, ref_conf, ref_map = run_reference(samples, method)
     our_aligned, our_value, our_conf, our_map = run_ours(samples, method)
