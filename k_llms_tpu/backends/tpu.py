@@ -32,6 +32,27 @@ MAX_EMBEDDING_TOKENS = 8191
 logger = logging.getLogger(__name__)
 
 
+def _visible_token_count(tok, ids: List[int], pos: int, text: str) -> int:
+    """Shortest token prefix whose decode REPRODUCES the visible text
+    ``text[:pos]`` (``text`` = the full decode of ``ids``).
+
+    Decoded LENGTH alone is the wrong predicate: byte-level tokenizers decode
+    partial UTF-8 sequences to replacement characters, so a prefix cut inside
+    a multi-byte character already has length >= pos while later tokens still
+    contribute to the visible characters (e.g. 'abc😀' is 7 byte tokens, but
+    'abc' + the first emoji byte decodes to 4 chars) — a length-only search
+    under-bills and truncates logprobs short of the returned text (ADVICE r3).
+    Scans from the front comparing the decoded prefix text itself; lengths are
+    completion-sized, so the linear scan is cheap.
+    """
+    visible = text[:pos]
+    for k in range(len(ids) + 1):
+        prefix = tok.decode(ids[:k])
+        if len(prefix) >= pos and prefix[:pos] == visible:
+            return k
+    return len(ids)
+
+
 class BackendConfig(BaseModel):
     """Engine configuration (the pydantic-settings pattern of the reference's
     ConsensusSettings, SURVEY.md §5 "Config/flag system"), extended with the
@@ -239,20 +260,11 @@ class TpuBackend(Backend):
             cuts = [pos for s in stop_strings if (pos := text.find(s)) != -1]
             if cuts:
                 pos = min(cuts)
-                text = text[:pos]
                 finish = "stop"
                 # Usage counts only tokens that contribute to the VISIBLE text
-                # (OpenAI neither returns nor continues past the stop): binary
-                # search the shortest token prefix covering it — decoded length
-                # is monotone in the token count.
-                lo, hi = 0, length
-                while lo < hi:
-                    mid = (lo + hi) // 2
-                    if len(tok.decode(ids[:mid])) >= pos:
-                        hi = mid
-                    else:
-                        lo = mid + 1
-                length = lo
+                # (OpenAI neither returns nor continues past the stop).
+                length = _visible_token_count(tok, ids, pos, text)
+                text = text[:pos]
             completion_tokens += length
             logprobs_payload = None
             if request.logprobs:

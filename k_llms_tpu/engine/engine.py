@@ -168,6 +168,24 @@ class LocalEngine:
         self.mesh = mesh
         if quantize is True:
             quantize = "int8"
+        if params is not None and not quantize:
+            # A PRE-quantized checkpoint passed with quantize unset must still
+            # route through the quantized spec/partitioning machinery: the
+            # bf16 pspecs tree doesn't match QTensor/Q4Tensor leaves, so the
+            # mesh device_put below would die in an opaque pytree/GSPMD error,
+            # and an unmarked Q4Tensor would skip the int4 mesh-compat check
+            # (ADVICE r3). Detect the stored layout and follow it.
+            from ..models.quant import stored_quant_layout
+
+            layout = stored_quant_layout(params)
+            if layout is not None:
+                quantize = layout
+                logger.info(
+                    "params tree is pre-quantized (%s); enabling quantize=%r "
+                    "to match the stored layout",
+                    self.config.name,
+                    quantize,
+                )
         int4_mesh_ok: Optional[bool] = None  # evaluated at most once per init
         if mesh is not None and quantize:
             from ..models.quant import int4_mesh_compatible, tree_has_q4
@@ -435,6 +453,15 @@ class LocalEngine:
             self._continue_cache[key] = fn
         return fn
 
+    @staticmethod
+    def _kv_seq_sharded(kv: KVCache) -> bool:
+        """Whether a prefix KV is stored SEQUENCE-SHARDED (axis 2 of
+        [L, B, S, KVH, D] partitioned over the data axis) — read from the
+        array's actual sharding, not from re-deriving the routing predicate,
+        so the label can never desync from the layout it describes."""
+        spec = getattr(getattr(kv.k, "sharding", None), "spec", None)
+        return bool(spec is not None and len(spec) > 2 and spec[2] == DATA_AXIS)
+
     def _prefix_store(
         self, ids: List[int], first_logits, prefix: KVCache, seq_sharded: bool = False
     ) -> None:
@@ -535,7 +562,15 @@ class LocalEngine:
         else:
             self.prefix_cache_stats["misses"] += 1
             first_logits, prefix = self._prefill_full(prompt_ids, prompt_len, bucket)
-        self._prefix_store(prompt_ids, first_logits, prefix)
+        # With sp_decode, an SP-routed prefill emits SEQUENCE-SHARDED KV;
+        # storing it unlabeled would hand it to the partial-hit continuation
+        # path later, whose eager slice/pad all-gathers the full O(S) prefix —
+        # the exact HBM spike the seq-sharded label exists to prevent
+        # (ADVICE r3). The label reads the array's actual layout.
+        self._prefix_store(
+            prompt_ids, first_logits, prefix,
+            seq_sharded=self._kv_seq_sharded(prefix),
+        )
         return first_logits, prefix
 
     def _prefill_full(self, prompt_ids: List[int], prompt_len: int, bucket: int):

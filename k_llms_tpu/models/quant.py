@@ -120,12 +120,31 @@ def int4_mesh_compatible(config, tp: int) -> bool:
     return True
 
 
+def _quant_leaf_nodes(params: "Dict[str, Any]"):
+    """The quantizable matmul leaf-nodes of a params tree (single source for
+    every stored-layout probe)."""
+    for key in _QUANT_LAYER_KEYS:
+        yield params["layers"].get(key)
+    yield params.get("lm_head")
+
+
 def tree_has_q4(params: "Dict[str, Any]") -> bool:
     """True when any quantized matmul leaf is stored int4 (pre-quantized
     checkpoints keep their layout through quantize_weight_bits)."""
-    leaves = [params["layers"].get(k) for k in _QUANT_LAYER_KEYS]
-    leaves.append(params.get("lm_head"))
-    return any(isinstance(w, Q4Tensor) for w in leaves)
+    return any(isinstance(w, Q4Tensor) for w in _quant_leaf_nodes(params))
+
+
+def stored_quant_layout(params: "Dict[str, Any]") -> "str | None":
+    """The quantization a params tree actually stores — 'int4' if any leaf is
+    Q4Tensor, 'int8' if any is QTensor, None for a plain bf16 tree. Lets a
+    caller follow a pre-quantized checkpoint's layout whatever flag was
+    passed."""
+    nodes = list(_quant_leaf_nodes(params))
+    if any(isinstance(w, Q4Tensor) for w in nodes):
+        return "int4"
+    if any(isinstance(w, QTensor) for w in nodes):
+        return "int8"
+    return None
 
 
 def align_quantized_specs(

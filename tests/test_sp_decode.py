@@ -154,3 +154,53 @@ def test_seq_sharded_cache_entry_never_partial_matches():
     eng.generate(short, n=2, max_new_tokens=2, temperature=0.5, seed=2)
     assert eng.prefix_cache_stats["partial_hits"] == 0
     assert eng.prefix_cache_stats["misses"] == 2
+
+
+def test_prefill_with_cache_labels_sp_entries_seq_sharded():
+    """The prefix-cache MISS path (generate_many / _prefill_routed) must store
+    SP-prefilled KV with the seq_sharded label (ADVICE r3): unlabeled, a later
+    longer prompt would partial-hit it and the replicated continuation would
+    all-gather the O(S) prefix."""
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True,
+        prefix_cache_size=2, prefix_cache_min_reuse=16,
+    )
+    eng._prefill_routed(PROMPT, len(PROMPT), 64)
+    entry = eng._prefix_entries[tuple(PROMPT)]
+    assert entry[4] is True, "SP-prefilled cache entry mislabeled as replicated"
+    assert entry[1].k.sharding.spec[2] == "data"
+    # A longer prompt sharing the whole prefix must NOT partial-hit it.
+    longer = PROMPT + PROMPT[:32]
+    eng._prefill_routed(longer, len(longer), 128)
+    assert eng.prefix_cache_stats["partial_hits"] == 0
+    assert eng.prefix_cache_stats["misses"] == 2
+
+
+def test_generate_many_with_sp_decode_prefix_cache_bit_equal():
+    """Coalesced requests through the sp_decode + prefix-cache engine must
+    reproduce the dense engine exactly (the resharding of the seq-sharded
+    entry to the replicated layout happens once, after _prefill_routed)."""
+    from k_llms_tpu.engine.engine import GenRequestSpec
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True,
+        prefix_cache_size=2, prefix_cache_min_reuse=16,
+    )
+    items = [
+        GenRequestSpec(prompt_ids=PROMPT, n=2, seed=7),
+        GenRequestSpec(prompt_ids=PROMPT[:20], n=2, seed=9),
+    ]
+    kw = dict(max_new_tokens=4, temperature=0.8)
+    got = eng.generate_many(items, **kw)
+    want = dense.generate_many(items, **kw)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g.tokens, w.tokens)

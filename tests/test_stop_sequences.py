@@ -188,3 +188,52 @@ def test_stop_window_match_properties():
     )
     got = [bool(x) for x in stop_window_match(win, stops)]
     assert got == [True, False, True, False, False]
+
+
+def test_visible_token_count_multibyte_boundaries():
+    """The billing scan must count every token contributing to the visible
+    text (ADVICE r3): partial UTF-8 decodes to replacement chars whose length
+    already covers the cut while later bytes still shape those chars, so a
+    length-only search (binary OR linear) under-bills. Hand-computed pins."""
+    from k_llms_tpu.backends.tpu import _visible_token_count
+    from k_llms_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cases = [
+        # (bytes, visible char count pos, expected token count)
+        (list("abc😀STOP".encode()), 4, 7),   # 'abc😀' = 3 + 4 emoji bytes
+        (list("abc😀STOP".encode()), 3, 3),   # 'abc' alone
+        (list("中文X".encode()), 1, 3),        # one 3-byte char
+        (list("中文X".encode()), 2, 6),        # both 3-byte chars
+        (list("aéb".encode()), 2, 3),          # 'aé' = 1 + 2 bytes
+        (list(b"a\xc3X"), 2, 2),               # lone truncated lead -> real U+FFFD
+        (list(b"\x9f\x9fa"), 2, 2),            # lone continuations, one char each
+    ]
+    for ids, pos, want in cases:
+        text = tok.decode(ids)
+        got = _visible_token_count(tok, ids, pos, text)
+        assert got == want, (bytes(ids), pos, got, want)
+        # The accepted prefix must reproduce the visible text exactly.
+        assert tok.decode(ids[:got])[:pos] == text[:pos]
+
+
+def test_stop_billing_covers_multibyte_visible_text(backend):
+    """End-to-end: force emoji bytes via logit_bias, stop right after them —
+    usage must bill all four bytes of the visible emoji, not the one-byte
+    prefix whose replacement char merely reaches the cut position."""
+    client = KLLMs(backend=backend)
+    emoji = "😀".encode()  # f0 9f 98 80
+    # Bias all four emoji bytes hugely: sampling emits only those bytes, so
+    # the text is a soup of replacement chars and (whenever the four bytes
+    # line up) real emoji — exactly the boundary the length-only scan got
+    # wrong. The stop cuts at the first full emoji.
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "m"}],
+        model="tiny",
+        n=2,
+        seed=17,
+        logit_bias={str(b): 100 for b in emoji},
+        stop="\N{GRINNING FACE}",
+    )
+    for choice in resp.choices[1:]:
+        assert "😀" not in (choice.message.content or "")
