@@ -46,7 +46,18 @@ def _visible_token_count(tok, ids: List[int], pos: int, text: str) -> int:
     completion-sized, so the linear scan is cheap.
     """
     visible = text[:pos]
-    for k in range(len(ids) + 1):
+    # Decoded length is NON-DECREASING in the token count (a token's bytes add
+    # >= 0 chars), so binary search gives the first k whose decode merely
+    # REACHES pos — a valid lower bound that makes the text-comparison scan
+    # O(log T) + the few boundary tokens instead of O(T^2) re-decodes.
+    lo, hi = 0, len(ids)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if len(tok.decode(ids[:mid])) >= pos:
+            hi = mid
+        else:
+            lo = mid + 1
+    for k in range(lo, len(ids) + 1):
         prefix = tok.decode(ids[:k])
         if len(prefix) >= pos and prefix[:pos] == visible:
             return k
@@ -268,6 +279,14 @@ class TpuBackend(Backend):
             completion_tokens += length
             logprobs_payload = None
             if request.logprobs:
+                # ``bytes`` carries each token's RAW bytes (OpenAI semantics:
+                # concatenating the entries reproduces the text's bytes, even
+                # across multi-byte UTF-8 split over several tokens); ``token``
+                # stays the per-token decode, replacement chars and all.
+                _tok_bytes = getattr(
+                    tok, "token_bytes", lambda t: tok.decode([t]).encode("utf-8")
+                )
+
                 def _top_entries(step: int):
                     if result.top_tokens is None:
                         return []
@@ -276,12 +295,11 @@ class TpuBackend(Backend):
                         result.top_tokens[i][step].tolist(),
                         result.top_logprobs[i][step].tolist(),
                     ):
-                        text_t = tok.decode([int(tid)])
                         entries.append(
                             {
-                                "token": text_t,
+                                "token": tok.decode([int(tid)]),
                                 "logprob": float(tlp),
-                                "bytes": list(text_t.encode("utf-8")),
+                                "bytes": list(_tok_bytes(int(tid))),
                             }
                         )
                     return entries
@@ -291,7 +309,7 @@ class TpuBackend(Backend):
                         {
                             "token": tok.decode([t]),
                             "logprob": float(lp),
-                            "bytes": [b for b in tok.decode([t]).encode("utf-8")],
+                            "bytes": list(_tok_bytes(int(t))),
                             "top_logprobs": _top_entries(j),
                         }
                         for j, (t, lp) in enumerate(

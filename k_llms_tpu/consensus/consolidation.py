@@ -120,7 +120,7 @@ def _consensus_over_contents(
                 contents,
                 scorer,
                 consensus_settings.min_support_ratio,
-                refinement_rounds=consensus_settings.alignment_refinement_rounds,
+                refinement_rounds=consensus_settings.effective_refinement_rounds,
             )
         contents = list(aligned_seq)
     return consensus_values(

@@ -146,7 +146,7 @@ def _medoid_consensus(
     """Similarity medoid (spec :1221-1237): the value with the highest mean
     similarity to the others wins; that mean (scaled) is the confidence.
 
-    With ``canonical_spelling`` (opt-in, see ConsensusSettings) ties at the
+    With ``canonical_spelling`` (default-on, see ConsensusSettings) ties at the
     max mean break toward the most frequent exact value among the tied
     candidates instead of np.argmax's first-index rule — normalized-identical
     case variants stop winning on position."""
@@ -262,7 +262,7 @@ def consensus_as_primitive(
 
     # (c) similarity medoid (strings or other structures).
     return _medoid_consensus(
-        values, scorer, parent_valid_frac, consensus_settings.canonical_spelling
+        values, scorer, parent_valid_frac, consensus_settings.effective_canonical_spelling
     )
 
 

@@ -61,9 +61,10 @@ def voting_consensus(
         for v, wi in zip(processed_values, valid_weights):
             tallies[v] += wi
         best_normalized, best_count = tallies.most_common(1)[0]
-        if consensus_settings.canonical_spelling:
-            # Opt-in: report the bucket's most common exact spelling (weighted;
-            # ties broken by first occurrence).
+        if consensus_settings.effective_canonical_spelling:
+            # Default-on (reference_exact turns it off): report the bucket's
+            # most common exact spelling (weighted; ties broken by first
+            # occurrence).
             spelling: Counter = Counter()
             for v, pv, wi in zip(valid_values, processed_values, valid_weights):
                 if pv == best_normalized:

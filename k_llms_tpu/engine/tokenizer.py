@@ -33,6 +33,13 @@ class ByteTokenizer:
         data = bytes(i for i in ids if 0 <= i < 256)
         return data.decode("utf-8", errors="replace")
 
+    def token_bytes(self, token_id: int) -> bytes:
+        """The token's RAW UTF-8 bytes (OpenAI logprobs ``bytes`` semantics:
+        concatenating entries reproduces the text's bytes — a per-token decode
+        would turn partial UTF-8 into replacement-char bytes instead).
+        Specials contribute no text."""
+        return bytes([token_id]) if 0 <= token_id < 256 else b""
+
     def apply_chat_template(
         self, messages: List[Dict[str, str]], add_generation_prompt: bool = True
     ) -> List[int]:
@@ -73,6 +80,29 @@ class HFTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def token_bytes(self, token_id: int) -> bytes:
+        """Raw bytes of one token (OpenAI logprobs ``bytes`` semantics). For
+        byte-level BPE vocabularies (GPT-2/Llama-3 style) the token string is
+        mapped back through the bytes↔unicode alphabet so partial UTF-8
+        sequences keep their true bytes; other vocabularies (SentencePiece)
+        fall back to the decoded text's bytes."""
+        if token_id in (self.bos_id, self.eos_id, self.pad_id):
+            return b""
+        tok_str = self._tok.convert_ids_to_tokens(int(token_id))
+        if tok_str is None:
+            return b""
+        if getattr(self, "_byte_decoder", None) is None:
+            try:
+                from transformers.models.gpt2.tokenization_gpt2 import bytes_to_unicode
+
+                self._byte_decoder = {c: b for b, c in bytes_to_unicode().items()}
+            except Exception:  # tokenization_gpt2 moved/absent: fallback only
+                self._byte_decoder = {}
+        bd = self._byte_decoder
+        if bd and all(c in bd for c in tok_str):
+            return bytes(bd[c] for c in tok_str)
+        return self.decode([token_id]).encode("utf-8")
 
     def apply_chat_template(
         self, messages: List[Dict[str, str]], add_generation_prompt: bool = True

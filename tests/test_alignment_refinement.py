@@ -1,4 +1,4 @@
-"""The opt-in alignment knobs that fix high-n quality (VERDICT r2 #3):
+"""The alignment knobs that fix high-n quality (VERDICT r2 #3, r3 #3):
 
 ``alignment_refinement_rounds`` — global re-assignment after the greedy
 reference election, undoing the cluster fragmentation that silently drops
@@ -6,8 +6,10 @@ majority-supported list rows at n>=16;
 ``canonical_spelling`` — vote/medoid winners reported in the bucket's most
 common exact spelling instead of the first-seen one.
 
-Both default OFF; the defaults stay reference-exact (pinned by the oracle
-differential suite, which runs with default settings).
+Both default ON (the reference's own headline n=32 config scores below its
+n=8 without them); ``ConsensusSettings(reference_exact=True)`` restores the
+reference's bit-exact behavior, and the oracle differential suite pins that
+mode.
 """
 
 import json
@@ -25,7 +27,7 @@ from k_llms_tpu.utils.quality import (
     make_noisy_samples,
 )
 
-TUNED = ConsensusSettings(alignment_refinement_rounds=2, canonical_spelling=True)
+FAITHFUL = ConsensusSettings(reference_exact=True)
 
 
 def _consensus(samples, settings=None, n=None):
@@ -42,13 +44,14 @@ def _consensus(samples, settings=None, n=None):
 def test_refinement_recovers_dropped_row_at_n32():
     """Seed 32/trial 0 is a known fragmentation case: the greedy election
     splits the 'Express shipping' cluster into two sub-majority groups and the
-    faithful path drops the row; refinement re-coalesces it."""
+    reference-exact path drops the row; the default (refined) path
+    re-coalesces it."""
     samples = make_noisy_samples(DEFAULT_TRUTH, 32, 0.15, 32)
 
-    faithful = _consensus(samples)
+    faithful = _consensus(samples, FAITHFUL)
     assert len(faithful["line_items"]) == 2  # the reference-faithful row drop
 
-    refined = _consensus(samples, ConsensusSettings(alignment_refinement_rounds=2))
+    refined = _consensus(samples)  # default posture
     assert len(refined["line_items"]) == 3
     descs = {r["description"] for r in refined["line_items"]}
     assert "Express shipping and handling" in descs
@@ -57,19 +60,17 @@ def test_refinement_recovers_dropped_row_at_n32():
 def test_refinement_noop_when_groups_already_stable():
     """On clean low-n input refinement must not change the result."""
     samples = make_noisy_samples(DEFAULT_TRUTH, 4, 0.05, 9)
-    assert _consensus(samples) == _consensus(
+    assert _consensus(samples, FAITHFUL) == _consensus(
         samples, ConsensusSettings(alignment_refinement_rounds=3)
     )
 
 
 def test_canonical_spelling_vote():
     values = ["USD", "usd", "usd", "usd"]
-    first_seen, _ = voting_consensus(values, ConsensusSettings())
+    first_seen, _ = voting_consensus(values, FAITHFUL)
     assert first_seen == "USD"  # reference-exact: first original in the bucket
-    canonical, conf = voting_consensus(
-        values, ConsensusSettings(canonical_spelling=True)
-    )
-    assert canonical == "usd"
+    canonical, conf = voting_consensus(values, ConsensusSettings())
+    assert canonical == "usd"  # default: the majority spelling wins
     assert conf == 1.0  # spelling choice must not change the confidence
 
 
@@ -78,20 +79,34 @@ def test_canonical_spelling_medoid_tiebreak():
     # identically so the first index wins ties unless canonical_spelling is on.
     values = ["EXTENDED WARRANTY, 24 MONTHS"] + ["Extended warranty, 24 months"] * 3
     doc = lambda s: json.dumps({"note": s})
-    faithful = _consensus([doc(v) for v in values])
+    faithful = _consensus([doc(v) for v in values], FAITHFUL)
     assert faithful["note"] == values[0]
-    tuned = _consensus([doc(v) for v in values], TUNED)
+    tuned = _consensus([doc(v) for v in values])  # default posture
     assert tuned["note"] == "Extended warranty, 24 months"
 
 
 def test_tuned_quality_monotone_and_above_bar():
-    """VERDICT r2 acceptance: n=32 quality >= n=8 quality, both >= 0.85."""
-    r = consensus_quality_eval(n_values=(8, 32), trials=6, consensus_settings=TUNED)
+    """VERDICT r2/r3 acceptance: n=32 quality >= n=8 quality, both >= 0.85 —
+    on the DEFAULT settings path."""
+    r = consensus_quality_eval(n_values=(8, 32), trials=6)
     assert r["truth_docs"] == 3
     assert r["consensus_n32"] >= r["consensus_n8"] >= 0.85
 
 
-def test_default_settings_unchanged_by_knobs():
-    s = ConsensusSettings()
-    assert s.alignment_refinement_rounds == 0
-    assert s.canonical_spelling is False
+def test_posture_resolution():
+    """Auto knobs resolve by posture; explicit values always win."""
+    default = ConsensusSettings()
+    assert default.effective_refinement_rounds == 2
+    assert default.effective_canonical_spelling is True
+
+    exact = ConsensusSettings(reference_exact=True)
+    assert exact.effective_refinement_rounds == 0
+    assert exact.effective_canonical_spelling is False
+
+    # Explicit overrides beat the posture in BOTH directions.
+    mixed = ConsensusSettings(reference_exact=True, alignment_refinement_rounds=1)
+    assert mixed.effective_refinement_rounds == 1
+    assert mixed.effective_canonical_spelling is False
+    off = ConsensusSettings(canonical_spelling=False)
+    assert off.effective_canonical_spelling is False
+    assert off.effective_refinement_rounds == 2

@@ -24,7 +24,11 @@ def test_main_emits_structured_json_when_relay_down(monkeypatch, capsys):
     monkeypatch.setattr(bench, "PROBE_BACKOFF_S", 0)
     monkeypatch.setattr(bench, "RUN_RETRIES", 0)
     # keep the test fast: stub the (hermetic but multi-second) quality eval
-    monkeypatch.setattr(bench, "bench_quality", lambda: {"tuned": {"consensus_n32": 1.0}})
+    monkeypatch.setattr(
+        bench,
+        "bench_quality",
+        lambda: {"default": {"consensus_n32": 1.0}, "reference_exact": {}},
+    )
 
     with pytest.raises(SystemExit) as exc_info:
         bench.main()
@@ -35,7 +39,7 @@ def test_main_emits_structured_json_when_relay_down(monkeypatch, capsys):
     assert len(out) == 1
     assert line["value"] is None and line["vs_baseline"] is None
     assert "device unavailable" in line["error"]
-    assert line["detail"]["quality"]["tuned"]["consensus_n32"] == 1.0
+    assert line["detail"]["quality"]["default"]["consensus_n32"] == 1.0
 
 
 def test_wait_for_device_returns_when_probe_passes(monkeypatch):

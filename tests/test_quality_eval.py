@@ -88,7 +88,9 @@ def test_quality_noise_model_matches_reference_consensus():
             json.loads(s) for s in make_noisy_samples(DEFAULT_TRUTH, 8, 0.25, 500 + trial)
         ]
         scorer = SimilarityScorer(method="levenshtein")
-        settings = ConsensusSettings(string_similarity_method="levenshtein")
+        settings = ConsensusSettings(
+            reference_exact=True, string_similarity_method="levenshtein"
+        )
         aligned, _ = recursive_list_alignments(samples, scorer, settings.min_support_ratio)
         ours, _ = consensus_values(aligned, settings, scorer)
 

@@ -148,7 +148,9 @@ def run_reference(samples, method):
 
 
 def run_ours(samples, method):
-    settings = ConsensusSettings(string_similarity_method=method)
+    # The oracle pins the reference-exact posture (VERDICT r3 #3): the
+    # DEFAULT posture intentionally diverges (refinement + canonical spelling).
+    settings = ConsensusSettings(reference_exact=True, string_similarity_method=method)
     scorer = SimilarityScorer(
         method=method, embed_fn=lambda ts: [deterministic_embedding(t) for t in ts]
     )
@@ -198,7 +200,7 @@ def test_parity_primitive_numeric(seed):
     scorer = SimilarityScorer(method="embeddings", embed_fn=lambda ts: embed(ts))
     our_val, our_conf = __import__(
         "k_llms_tpu.consensus.primitive", fromlist=["consensus_as_primitive"]
-    ).consensus_as_primitive(values, ConsensusSettings(), scorer)
+    ).consensus_as_primitive(values, ConsensusSettings(reference_exact=True), scorer)
     if ref_val is None:
         assert our_val is None
     else:
@@ -215,7 +217,7 @@ def test_parity_voting(seed):
     ref_out = ref.voting_consensus(values, ref.ConsensusSettings())
     from k_llms_tpu.consensus.voting import voting_consensus
 
-    our_out = voting_consensus(values, ConsensusSettings())
+    our_out = voting_consensus(values, ConsensusSettings(reference_exact=True))
     assert our_out == ref_out
 
 
@@ -313,11 +315,12 @@ def test_parity_gnarly_structures(seed, method):
 
 @pytest.mark.parametrize("seed", range(6))
 def test_parity_headline_n32(seed):
-    """The reference-faithful DEFAULT path at the headline consensus size
+    """The reference_exact posture at the headline consensus size
     (n in 24..32): exactly the regime where the greedy election fragments
     clusters and support pruning drops rows — whatever the reference does
-    there (including the row drop) must be reproduced bit-for-bit, since the
-    fix is an opt-in knob (alignment_refinement_rounds), not a drift."""
+    there (including the row drop) must be reproduced bit-for-bit under
+    reference_exact=True (the DEFAULT posture fixes the drop instead —
+    test_alignment_refinement.py pins that side)."""
     rng = random.Random(31_000 + seed)
     base = make_gnarly_record(rng)
     n = rng.randint(24, 32)

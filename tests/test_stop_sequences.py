@@ -218,22 +218,34 @@ def test_visible_token_count_multibyte_boundaries():
 
 
 def test_stop_billing_covers_multibyte_visible_text(backend):
-    """End-to-end: force emoji bytes via logit_bias, stop right after them —
-    usage must bill all four bytes of the visible emoji, not the one-byte
-    prefix whose replacement char merely reaches the cut position."""
+    """End-to-end: force emoji bytes via logit_bias so the text is a soup of
+    replacement chars (partial UTF-8) — exactly the boundary the length-only
+    scan got wrong. The billed tokens are pinned through the logprobs payload:
+    their concatenated BYTES must decode back to the visible text, and usage
+    must equal their count — the old under-billing predicate produced a byte
+    prefix whose decode fell short of the returned text."""
     client = KLLMs(backend=backend)
     emoji = "😀".encode()  # f0 9f 98 80
-    # Bias all four emoji bytes hugely: sampling emits only those bytes, so
-    # the text is a soup of replacement chars and (whenever the four bytes
-    # line up) real emoji — exactly the boundary the length-only scan got
-    # wrong. The stop cuts at the first full emoji.
     resp = client.chat.completions.create(
         messages=[{"role": "user", "content": "m"}],
         model="tiny",
         n=2,
         seed=17,
+        logprobs=True,
         logit_bias={str(b): 100 for b in emoji},
         stop="\N{GRINNING FACE}",
     )
+    billed_total = 0
+    saw_billed_bytes = False
     for choice in resp.choices[1:]:
-        assert "😀" not in (choice.message.content or "")
+        text = choice.message.content or ""
+        assert "😀" not in text
+        entries = choice.logprobs.content if choice.logprobs else []
+        billed_total += len(entries)
+        if entries:
+            saw_billed_bytes = True
+        billed_bytes = b"".join(bytes(e.bytes) for e in entries)
+        decoded = billed_bytes.decode("utf-8", errors="replace")
+        assert decoded[: len(text)] == text, (billed_bytes, text)
+    assert resp.usage.completion_tokens == billed_total
+    assert saw_billed_bytes  # the soup must actually bill something
