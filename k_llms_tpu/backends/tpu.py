@@ -114,10 +114,9 @@ class BackendConfig(BaseModel):
     # coalesce. Every request that reaches an EMPTY queue pays it — ~5 ms on
     # a ~1 s decode. Set 0.0 for latency-critical solo deployments (burst
     # coalescing then relies on queue backlog alone).
-    # NB: speculative decoding runs only through the SOLO path — coalesced
-    # bursts take generate_many's normal loop (spec_stats reports
-    # {"mode": "coalesced_fallback"} there), so under concurrency a larger
-    # window trades speculation's per-request speedup for batch throughput.
+    # NB: speculative decoding composes with coalescing (the R-request spec
+    # loop drafts each row from its own request's prompt table), so the
+    # window no longer trades speculation away for batch throughput.
     batch_window: float = 0.005
 
 

@@ -142,6 +142,23 @@ def _bucket(n: int, minimum: int = 32) -> int:
     return b
 
 
+def _spec_acceptance_stats(count_np: np.ndarray, iters_np: np.ndarray) -> Dict[str, Any]:
+    """Acceptance observability over a row slice: tokens each row emitted per
+    verify it entered. 1.0 = no draft ever accepted; > 1 is the speculative
+    win users tune spec_lookahead against. The FIRST token comes from prefill
+    logits, not a verify (hence count - 1). Single source for the solo loop,
+    the coalesced per-request slices, and the engine-level mirror — the
+    convention must never drift between them."""
+    rates = (count_np - 1.0) / np.maximum(iters_np, 1)
+    ran = iters_np > 0
+    return {
+        "verify_iterations": int(iters_np.max(initial=0)),
+        "tokens_per_iteration": (
+            round(float(rates[ran].mean()), 3) if ran.any() else None
+        ),
+    }
+
+
 class LocalEngine:
     """Owns params on the mesh plus jit caches for prefill/decode/embedding."""
 
@@ -810,6 +827,7 @@ class LocalEngine:
     # -- speculative decode loop ------------------------------------------
     def _get_spec_decode_loop(
         self,
+        num_requests: int,
         n_per: int,
         max_new: int,
         temperature: float,
@@ -823,7 +841,11 @@ class LocalEngine:
         use_logit_bias: bool = False,
         use_stops: bool = False,
     ):
-        """Jitted prompt-lookup speculative loop (single request, no mesh).
+        """Jitted prompt-lookup speculative loop for R requests x n_per rows
+        (R=1 is the solo case; R>1 the cross-request coalesced batch, each
+        row drafting from ITS OWN request's prompt table — VERDICT r3 #5).
+        Runs on a mesh too — rows shard over the data axis and the K+1-wide
+        verify forward is tensor-parallel like any other forward (r3 #4).
 
         State carries per-row buffered-token counts instead of a global step:
         each iteration drafts K tokens from the prompt, verifies the row's
@@ -853,9 +875,9 @@ class LocalEngine:
         elif constraint is not None and constraint != "json":
             constraint_key = ("schema", constraint.digest)
         cache_key = (
-            "spec", n_per, max_new, temperature, top_p, top_k, K, bucket,
-            constraint_key, top_logprobs, frequency_penalty, presence_penalty,
-            use_logit_bias, use_stops,
+            "spec", num_requests, n_per, max_new, temperature, top_p, top_k, K,
+            bucket, constraint_key, top_logprobs, frequency_penalty,
+            presence_penalty, use_logit_bias, use_stops,
         )
         fn = self._spec_decode_cache.get(cache_key)
         if fn is not None:
@@ -870,7 +892,7 @@ class LocalEngine:
 
         config = self.config
         pad_id = config.pad_token_id
-        B = n_per
+        R, B = num_requests, num_requests * n_per
         BUF = max_new + K + 1
         cops = _constraint_ops(constraint)
         if cops is not None:
@@ -878,18 +900,27 @@ class LocalEngine:
         penalized = frequency_penalty != 0.0 or presence_penalty != 0.0
         KT = top_logprobs or 0
 
-        def _row_keys(req_key, step_id):
-            sk = jax.random.fold_in(req_key, step_id)
-            return jax.vmap(lambda i: jax.random.fold_in(sk, i))(jnp.arange(B))
+        def _row_keys(req_keys, step_id):
+            # fold(req key, step) then row-WITHIN-request: a request's sampling
+            # stream is independent of what it was batched with (and, with
+            # R=1, identical to the solo loop's fold chain).
+            sk = jax.vmap(jax.random.fold_in, in_axes=(0, None))(req_keys, step_id)
+            rk = jax.vmap(
+                lambda k: jax.vmap(lambda i: jax.random.fold_in(k, i))(jnp.arange(n_per))
+            )(sk)
+            return rk.reshape(B)
 
         def _sel(cond, a, b):
             """where() with ``cond`` [B] broadcast over a/b's trailing dims."""
             return jnp.where(cond.reshape(cond.shape + (1,) * (a.ndim - 1)), a, b)
 
         def _loop(
-            params, prefix, prompt_tokens, prompt_len, first_logits, req_key,
+            params, prefix, prompt_tokens, prompt_lens, first_logits, req_keys,
             eos_ids, bias, stops,
         ):
+            # prompt_tokens [R, S] / prompt_lens [R]: each request's padded
+            # prompt table; rows are request-major so row b drafts from table
+            # b // n_per (materialized per-row below for the vmapped lookup).
             sample = partial(
                 sample_logits, temperature=temperature, top_p=top_p, top_k=top_k
             )
@@ -903,17 +934,23 @@ class LocalEngine:
 
             jstate = initial_state(B) if cops is not None else None
 
+            prompt_row = jnp.repeat(prompt_tokens, n_per, axis=0)  # [B, S]
+            plen_row = jnp.repeat(prompt_lens, n_per)  # [B]
+
             V = first_logits.shape[-1]
-            logits0 = jnp.broadcast_to(first_logits, (B, V))
+            logits0 = jnp.broadcast_to(
+                first_logits[:, None, :], (R, n_per, V)
+            ).reshape(B, V)
             if jstate is not None:
                 logits0 = mask_logits(jt, logits0, *jstate, eos_ids)
             logits0 = _mask_pad(logits0)
             tok0, lp0 = sample(
                 logits0,
                 None,
-                row_keys=_row_keys(req_key, 0),
+                row_keys=_row_keys(req_keys, 0),
                 penalty=-bias[None, :] if use_logit_bias else None,
             )
+            tok0 = self._constraint(tok0, batch_spec())
             if jstate is not None:
                 jstate = advance(jt, tok0, *jstate)
             toks = jnp.full((B, BUF), pad_id, jnp.int32).at[:, 0].set(tok0)
@@ -941,6 +978,10 @@ class LocalEngine:
             done0 = eos0 | (count0 >= max_new)
 
             gen_cache = init_cache(config, B, BUF)
+            gen_cache = KVCache(
+                k=self._constraint(gen_cache.k, cache_specs()),
+                v=self._constraint(gen_cache.v, cache_specs()),
+            )
 
             def cond(state):
                 it, count, done, *_ = state
@@ -958,16 +999,18 @@ class LocalEngine:
                     jnp.take_along_axis(
                         toks, jnp.maximum(count - 2, 0)[:, None], axis=1
                     )[:, 0],
-                    prompt_tokens[prompt_len - 1],
+                    jnp.take_along_axis(
+                        prompt_row, (plen_row - 1)[:, None], axis=1
+                    )[:, 0],
                 )
                 drafts = propose_prompt_lookup(
-                    prompt_tokens, prompt_len, prev, cur, K,
+                    prompt_row, plen_row, prev, cur, K,
                     gen=toks, gen_len=count,
                 )  # [B, K]
                 block = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, K+1]
                 logits, cache = verify_step(
                     config, params, block, count - 1,
-                    jnp.asarray([prompt_len], jnp.int32), cache, prefix,
+                    prompt_lens, cache, prefix,
                 )
                 # Grammar masking per position: state after the emitted prefix
                 # advanced through drafts[:j] (the only prefix under which
@@ -1010,15 +1053,28 @@ class LocalEngine:
                     pen_flat = jnp.broadcast_to(
                         -bias[None, None, :], (B, K + 1, V)
                     ).reshape(B * (K + 1), V)
-                it_key = jax.random.fold_in(req_key, it)
+                # fold(req key, iteration) -> position -> row-within-request:
+                # with R=1 the chain is identical to the solo loop's.
+                it_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                    req_keys, it
+                )  # [R]
+                jk = jax.vmap(
+                    lambda j: jax.vmap(lambda kk: jax.random.fold_in(kk, j))(it_keys)
+                )(jnp.arange(K + 1))  # [K+1, R]
                 pos_keys = jax.vmap(
-                    lambda j: jax.vmap(
-                        lambda r: jax.random.fold_in(jax.random.fold_in(it_key, j), r)
-                    )(jnp.arange(B))
-                )(jnp.arange(K + 1))  # [K+1, B]
-                flat_keys = jnp.swapaxes(pos_keys, 0, 1).reshape(B * (K + 1))
+                    jax.vmap(
+                        lambda kk: jax.vmap(lambda i: jax.random.fold_in(kk, i))(
+                            jnp.arange(n_per)
+                        )
+                    )
+                )(jk)  # [K+1, R, n_per]
+                flat_keys = jnp.moveaxis(
+                    pos_keys.reshape(K + 1, B), 0, 1
+                ).reshape(B * (K + 1))
                 t_flat, lp_flat = sample(flat, None, row_keys=flat_keys, penalty=pen_flat)
-                sampled = t_flat.reshape(B, K + 1)
+                sampled = self._constraint(
+                    t_flat.reshape(B, K + 1), P(DATA_AXIS, None)
+                )
                 lp_arr = lp_flat.reshape(B, K + 1)
 
                 budget = jnp.where(done, 0, max_new - count)
@@ -1111,6 +1167,7 @@ class LocalEngine:
         prompt_len: int,
         bucket: int,
         n: int,
+        n_padded: int,
         max_new_tokens: int,
         temperature: float,
         top_p: Optional[float],
@@ -1128,17 +1185,17 @@ class LocalEngine:
         config = self.config
         first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
         prompt_buf = jnp.array(
-            prompt_ids + [config.pad_token_id] * (bucket - prompt_len), jnp.int32
-        )
+            [prompt_ids + [config.pad_token_id] * (bucket - prompt_len)], jnp.int32
+        )  # [1, S] — the R=1 case of the request-major prompt tables
         loop = self._get_spec_decode_loop(
-            n, max_new_tokens, temperature, top_p, top_k, bucket,
+            1, n_padded, max_new_tokens, temperature, top_p, top_k, bucket,
             constraint, top_logprobs, frequency_penalty, presence_penalty,
             use_logit_bias=logit_bias is not None,
             use_stops=use_stops,
         )
         toks, lps, hit_eos, count, row_iters, tt, tl = loop(
-            self.params, prefix, prompt_buf, jnp.int32(prompt_len),
-            first_logits, jax.random.key(seed), eos_arr,
+            self.params, prefix, prompt_buf, jnp.array([prompt_len], jnp.int32),
+            first_logits, jnp.stack([jax.random.key(seed)]), eos_arr,
             self._bias_array(logit_bias),
             stop_arr if stop_arr is not None else self._stop_array(None)[0],
         )
@@ -1147,19 +1204,7 @@ class LocalEngine:
             jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
         )
         toks_np, lps_np, eos_np = toks_np[:n], lps_np[:n], eos_np[:n]
-        # Acceptance observability, PER ROW (rows stop at different times):
-        # tokens each row emitted per verify it entered. 1.0 = no draft ever
-        # accepted; > 1 is the speculative win users tune spec_lookahead
-        # against. The first token comes from prefill logits, not a verify.
-        ri = iters_np[:n]
-        rates = (count_np[:n] - 1.0) / np.maximum(ri, 1)
-        ran = ri > 0
-        spec_stats = {
-            "verify_iterations": int(ri.max(initial=0)),
-            "tokens_per_iteration": (
-                round(float(rates[ran].mean()), 3) if ran.any() else None
-            ),
-        }
+        spec_stats = _spec_acceptance_stats(count_np[:n], iters_np[:n])
         self.spec_stats = spec_stats
         # Same length convention as the normal loop: count non-pad tokens, so
         # a pad-mapped-to-eos stop token is excluded identically in both modes
@@ -1175,6 +1220,78 @@ class LocalEngine:
             top_logprobs=tl_np[:n] if top_logprobs else None,
             spec_stats=spec_stats,
         )
+
+    def _finish_many_speculative(
+        self, items, preps, n_per, max_new_tokens, temperature, top_p, top_k,
+        constraint, top_logprobs, frequency_penalty, presence_penalty,
+        logit_bias, use_stops, stop_arr, eos_arr, r_pad, bucket_max,
+        prefix, prompt_bufs, prompt_lens, first_logits, req_keys,
+    ) -> List[GenerationResult]:
+        """generate_many's speculative tail: run the R-request spec loop and
+        slice per-request results + acceptance stats (VERDICT r3 #5)."""
+        config = self.config
+        loop = self._get_spec_decode_loop(
+            r_pad, n_per, max_new_tokens, temperature, top_p, top_k, bucket_max,
+            constraint, top_logprobs, frequency_penalty, presence_penalty,
+            use_logit_bias=logit_bias is not None,
+            use_stops=use_stops,
+        )
+        toks, lps, hit_eos, count, row_iters, tt, tl = loop(
+            self.params, prefix, prompt_bufs, prompt_lens, first_logits,
+            req_keys, eos_arr, self._bias_array(logit_bias), stop_arr,
+        )
+        toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
+            np.asarray,
+            jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
+        )
+        results = self._slice_many_results(
+            items, preps, n_per, toks_np, lps_np, eos_np, tt_np, tl_np,
+            top_logprobs,
+            spec_stats_fn=lambda lo, n_j: _spec_acceptance_stats(
+                count_np[lo : lo + n_j], iters_np[lo : lo + n_j]
+            ),
+        )
+        # The engine-level mirror summarizes the whole coalesced batch (real
+        # rows only — per-request row padding and batch padding excluded).
+        idx = np.concatenate(
+            [
+                np.arange(j * n_per, j * n_per + max(1, it.n))
+                for j, it in enumerate(items)
+            ]
+        )
+        self.spec_stats = {
+            "coalesced_requests": len(items),
+            **_spec_acceptance_stats(count_np[idx], iters_np[idx]),
+        }
+        return results
+
+    def _slice_many_results(
+        self, items, preps, n_per, toks_np, lps_np, finish_np, tt_np, tl_np,
+        top_logprobs, spec_stats_fn,
+    ) -> List[GenerationResult]:
+        """Shared generate_many result assembly (normal AND speculative
+        coalesced paths): per-request row slices, non-pad lengths, stop/length
+        finish reasons — one place for the conventions."""
+        results: List[GenerationResult] = []
+        for j, (it, (_, prompt_len, _)) in enumerate(zip(items, preps)):
+            lo, n_j = j * n_per, max(1, it.n)
+            t = toks_np[lo : lo + n_j]
+            lengths = (t != self.config.pad_token_id).sum(axis=1).astype(np.int32)
+            results.append(
+                GenerationResult(
+                    tokens=t,
+                    logprobs=lps_np[lo : lo + n_j],
+                    lengths=lengths,
+                    finish_reasons=[
+                        "stop" if d else "length" for d in finish_np[lo : lo + n_j]
+                    ],
+                    prompt_len=prompt_len,
+                    top_tokens=tt_np[lo : lo + n_j] if top_logprobs else None,
+                    top_logprobs=tl_np[lo : lo + n_j] if top_logprobs else None,
+                    spec_stats=spec_stats_fn(lo, n_j),
+                )
+            )
+        return results
 
     def _stop_array(
         self, stop_sequences: Optional[Sequence[Sequence[int]]]
@@ -1332,27 +1449,6 @@ class LocalEngine:
         spec_stats: Dict[str, Any] = {}
         self.spec_stats = spec_stats
 
-        # Prompt-lookup speculative decode (single-chip): composes with
-        # constraints, penalties, top_logprobs, logit_bias (VERDICT r2 #4) and
-        # device stop sequences (windowed suffix match truncates the emitted
-        # run at the first in-block hit). Remaining fallback: a mesh (the
-        # sharded batched loop only).
-        if self.speculative == "prompt_lookup":
-            if self.mesh is None:
-                return self._generate_speculative(
-                    prompt_ids, prompt_len, bucket, n, max_new_tokens,
-                    temperature, top_p, top_k, seed, eos_arr,
-                    constraint, top_logprobs, frequency_penalty,
-                    presence_penalty, logit_bias,
-                    stop_arr=stop_arr, use_stops=use_stops,
-                )
-            # Explicit sentinel so operators can tell a served-by-normal-loop
-            # request from zero draft acceptance (ADVICE r2).
-            spec_stats = {"mode": "fallback"}
-            self.spec_stats = spec_stats
-
-        req_keys = jnp.stack([jax.random.key(seed)])
-
         # Ring-decode route (sp_decode): prompts taking the SP prefill keep
         # their KV sequence-sharded and decode against it in place. Exact
         # prefix-cache hits compose (the cached seq-sharded KV feeds the ring
@@ -1364,6 +1460,28 @@ class LocalEngine:
             and self.mesh is not None
             and self._use_sp_prefill(prompt_len, bucket)
         )
+
+        # Prompt-lookup speculative decode: composes with constraints,
+        # penalties, top_logprobs, logit_bias (VERDICT r2 #4), device stop
+        # sequences, and a MESH (rows shard over data, the verify forward is
+        # tensor-parallel — VERDICT r3 #4). Remaining fallback: an SP-resident
+        # prompt (the ring-decode loop attends the sequence-sharded prefix;
+        # verify_step doesn't).
+        if self.speculative == "prompt_lookup":
+            if not sp_resident:
+                return self._generate_speculative(
+                    prompt_ids, prompt_len, bucket, n, n_padded, max_new_tokens,
+                    temperature, top_p, top_k, seed, eos_arr,
+                    constraint, top_logprobs, frequency_penalty,
+                    presence_penalty, logit_bias,
+                    stop_arr=stop_arr, use_stops=use_stops,
+                )
+            # Explicit sentinel so operators can tell a served-by-normal-loop
+            # request from zero draft acceptance (ADVICE r2).
+            spec_stats = {"mode": "sp_decode_fallback"}
+            self.spec_stats = spec_stats
+
+        req_keys = jnp.stack([jax.random.key(seed)])
         if sp_resident:
             key = tuple(prompt_ids)
             hit = self._prefix_entries.get(key) if self.prefix_cache_size else None
@@ -1476,13 +1594,7 @@ class LocalEngine:
         eos_arr = jnp.array(eos + [-1] * (MAX_EOS_IDS - len(eos)), jnp.int32)
         self._validate_constraint(constraint, eos)
 
-        many_spec_stats: Dict[str, Any] = {}
-        if self.speculative:
-            # Coalesced bursts take the normal batched loop; the sentinel keeps
-            # that visible (admission-window coalescing would otherwise silently
-            # drop speculation for concurrent extraction bursts — ADVICE r2).
-            many_spec_stats = {"mode": "coalesced_fallback"}
-        self.spec_stats = many_spec_stats
+        self.spec_stats = {}
 
         preps = [self._prep_prompt(it.prompt_ids) for it in items]
         bucket_max = max(bucket for _, _, bucket in preps)
@@ -1543,6 +1655,27 @@ class LocalEngine:
         req_keys = jnp.stack([jax.random.key(s) for s in seeds])
 
         stop_arr, use_stops = self._stop_array(stop_sequences)
+
+        # Coalesced SPECULATIVE decode (VERDICT r3 #5): the R-request spec
+        # loop drafts each row from ITS OWN request's prompt table — the
+        # admission-window extraction bursts that coalesce are exactly the
+        # prompt-copying workloads prompt-lookup accelerates. Same semantics
+        # as the normal coalesced loop (differential-tested); stats per
+        # request on each GenerationResult.
+        if self.speculative == "prompt_lookup":
+            prompt_bufs = np.full((r_pad, bucket_max), config.pad_token_id, np.int32)
+            for j, (ids_j, plen_j, _) in enumerate(preps):
+                prompt_bufs[j, :plen_j] = ids_j
+            if extra:
+                prompt_bufs[len(items):] = prompt_bufs[len(items) - 1]
+            return self._finish_many_speculative(
+                items, preps, n_per, max_new_tokens, temperature, top_p, top_k,
+                constraint, top_logprobs, frequency_penalty, presence_penalty,
+                logit_bias, use_stops, stop_arr, eos_arr, r_pad, bucket_max,
+                prefix, jnp.asarray(prompt_bufs), prompt_lens, first_logits,
+                req_keys,
+            )
+
         loop = self._get_decode_loop(
             r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
@@ -1553,33 +1686,13 @@ class LocalEngine:
             self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr,
             self._bias_array(logit_bias), stop_arr,
         )
-        toks_np, lps_np, done_np, tt_np, tl_np = jax.device_get(
-            (toks, lps, done, tt, tl)
-        )
         toks_np, lps_np, done_np, tt_np, tl_np = map(
-            np.asarray, (toks_np, lps_np, done_np, tt_np, tl_np)
+            np.asarray, jax.device_get((toks, lps, done, tt, tl))
         )
-
-        results: List[GenerationResult] = []
-        for j, (it, (_, prompt_len, _)) in enumerate(zip(items, preps)):
-            lo, n_j = j * n_per, max(1, it.n)
-            t = toks_np[lo : lo + n_j]
-            l = lps_np[lo : lo + n_j]
-            d = done_np[lo : lo + n_j]
-            lengths = (t != config.pad_token_id).sum(axis=1).astype(np.int32)
-            results.append(
-                GenerationResult(
-                    tokens=t,
-                    logprobs=l,
-                    lengths=lengths,
-                    finish_reasons=["stop" if x else "length" for x in d],
-                    prompt_len=prompt_len,
-                    top_tokens=tt_np[lo : lo + n_j] if top_logprobs else None,
-                    top_logprobs=tl_np[lo : lo + n_j] if top_logprobs else None,
-                    spec_stats=many_spec_stats,
-                )
-            )
-        return results
+        return self._slice_many_results(
+            items, preps, n_per, toks_np, lps_np, done_np, tt_np, tl_np,
+            top_logprobs, spec_stats_fn=lambda lo, n_j: {},
+        )
 
     # -- embeddings (similarity side-channel) -----------------------------
     def _get_embed(self, batch: int, bucket: int):

@@ -277,7 +277,9 @@ def quantize_params(params: Dict[str, Any], bits: int = 8) -> Dict[str, Any]:
     return out
 
 
-def init_params_quantized(config, key: jax.Array, dtype=None, bits: int = 8) -> Dict[str, Any]:
+def init_params_quantized(
+    config, key: jax.Array, dtype=None, bits: int = 8, dist: str = "random"
+) -> Dict[str, Any]:
     """Random int8-quantized init, building the QTensor tree DIRECTLY.
 
     For synthetic flagship benches: an 8B bf16 tree (~16 GB) cannot sit in one
@@ -287,12 +289,29 @@ def init_params_quantized(config, key: jax.Array, dtype=None, bits: int = 8) -> 
     have ~N(0, 1/fan_in) magnitude (finite logits; a random model is all a
     synthetic bench needs). Mirrors the tree structure of
     ``llama.init_params`` + ``quantize_params``.
+
+    ``dist="cheap"`` replaces every PRNG draw with a broadcast deterministic
+    pattern (same shapes/scales, zero threefry work). For sharding dry runs on
+    virtual CPU meshes: non-partitionable threefry gets REPLICATED under
+    GSPMD — every virtual device computes the full billion-element draw — so
+    a random 8B-width init costs minutes of host time that validates nothing
+    the pattern init doesn't (the dry run checks layouts and compiled
+    programs, not weight statistics).
     """
     import math
 
+    if dist not in ("random", "cheap"):
+        raise ValueError(f"Unknown dist {dist!r}; use 'random' or 'cheap'")
+    cheap = dist == "cheap"
     dtype = dtype or config.jax_dtype
     H, I, V = config.hidden_size, config.intermediate_size, config.vocab_size
     L, Q, KV = config.num_layers, config.q_dim, config.kv_dim
+
+    def _pattern_i8(shape) -> jax.Array:
+        # Varies along the output-channel axis only: broadcast is trivially
+        # partitionable, and matmul outputs stay non-degenerate.
+        row = ((jnp.arange(shape[-1]) * 37) % 251 - 125).astype(jnp.int8)
+        return jnp.broadcast_to(row, shape)
 
     def qinit(k, shape) -> WeightLike:
         K, N = shape[-2], shape[-1]
@@ -303,17 +322,29 @@ def init_params_quantized(config, key: jax.Array, dtype=None, bits: int = 8) -> 
             # (std = sqrt(E[k^2]-mu^2) over -8..7 ~= 4.61); scale so effective
             # weights are ~N(0, 1/fan_in).
             nibble_std = math.sqrt(sum(v * v for v in range(-8, 8)) / 16 - 0.25)
-            q = jax.random.randint(k, shape[:-2] + (K // 2, N), -128, 128, jnp.int8)
+            pshape = shape[:-2] + (K // 2, N)
+            q = (
+                _pattern_i8(pshape)
+                if cheap
+                else jax.random.randint(k, pshape, -128, 128, jnp.int8)
+            )
             scale_val = 1.0 / (nibble_std * math.sqrt(K))
             scale = jnp.full(shape[:-2] + (K // GROUP, N), scale_val, jnp.float32)
             return Q4Tensor(q=q, scale=scale)
-        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        q = (
+            _pattern_i8(shape)
+            if cheap
+            else jax.random.randint(k, shape, -127, 128, jnp.int8)
+        )
         # std(uniform int8) = 127/sqrt(3); scale it to 1/sqrt(fan_in).
         scale_val = math.sqrt(3.0) / (127.0 * math.sqrt(shape[-2]))
         scale = jnp.full(shape[:-2] + (1, shape[-1]), scale_val, jnp.float32)
         return QTensor(q=q, scale=scale)
 
     def normal(k, shape, scale):
+        if cheap:
+            row = ((jnp.arange(shape[-1]) * 53) % 17 - 8).astype(jnp.float32) / 8.0
+            return jnp.broadcast_to(row * scale, shape).astype(dtype)
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
     k_embed, k_layers, k_head = jax.random.split(key, 3)

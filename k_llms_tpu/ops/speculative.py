@@ -46,7 +46,9 @@ def propose_prompt_lookup(
     gen_len: "jax.Array | None" = None,
 ) -> jax.Array:
     """Per-row drafts from the prompt and (optionally) the row's own generated
-    text. prompt: [S] token buffer (padded); prompt_len: scalar valid length;
+    text. prompt: [S] token buffer shared by all rows, or [B, S] PER-ROW
+    buffers (coalesced batches: each request's rows search their own prompt);
+    prompt_len: scalar valid length, or [B] per-row lengths with a 2D prompt;
     prev/cur: [B] the row's trailing bigram; gen: [B, T] generated-token
     buffers with valid lengths gen_len [B].
 
@@ -57,17 +59,22 @@ def propose_prompt_lookup(
     fall back to repeating ``cur`` (harmless: the verify sampler just won't
     match them).
     """
-    S = prompt.shape[0]
+    S = prompt.shape[-1]
     pos = jnp.arange(1, S)
 
-    def from_prompt(a, b):
-        hit = (prompt[:-1] == a) & (prompt[1:] == b) & (pos < prompt_len)
+    def from_prompt(p, plen, a, b):
+        hit = (p[:-1] == a) & (p[1:] == b) & (pos < plen)
         last = jnp.max(jnp.where(hit, pos, -1))  # index of the bigram's 2nd token
         idx = last + 1 + jnp.arange(k)
-        ok = (last >= 0) & (idx < prompt_len)
-        return jnp.where(ok, prompt[jnp.clip(idx, 0, S - 1)], b).astype(jnp.int32)
+        ok = (last >= 0) & (idx < plen)
+        return jnp.where(ok, p[jnp.clip(idx, 0, S - 1)], b).astype(jnp.int32)
 
-    drafts = jax.vmap(from_prompt)(prev, cur)
+    if prompt.ndim == 2:
+        drafts = jax.vmap(from_prompt)(
+            prompt, jnp.broadcast_to(prompt_len, prev.shape), prev, cur
+        )
+    else:
+        drafts = jax.vmap(lambda a, b: from_prompt(prompt, prompt_len, a, b))(prev, cur)
     if gen is None:
         return drafts
 

@@ -104,9 +104,9 @@ def test_engine_stats_captured_at_generation_time(monkeypatch):
         messages=[{"role": "user", "content": "q q q q"}], model="tiny", n=2, seed=1
     )
     captured = dict(resp.engine_stats["spec"])
-    # under the 8-device test mesh the spec path falls back (mesh gate); the
-    # capture must reflect THIS request's actual mode either way
-    assert captured in ({"mode": "fallback"},) or "verify_iterations" in captured
+    # the spec loop serves on the mesh too (r3 #4); the capture must reflect
+    # THIS request's actual generation-time stats
+    assert "verify_iterations" in captured, captured
     # simulate a concurrent request overwriting the shared engine field
     backend.engine.spec_stats = {"verify_iterations": 999}
     assert resp.engine_stats["spec"] == captured  # trace unaffected

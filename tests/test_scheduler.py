@@ -314,10 +314,10 @@ def test_concurrent_traced_requests_keep_their_own_spec_stats(monkeypatch):
         assert set(stats) == {"spec", "prefix_cache", "scheduler"}
         spec = stats["spec"]
         # Each request's spec stats must be a VALID generation-time value for
-        # that request: the spec loop's acceptance numbers (solo-served, mesh
-        # permitting), or a fallback sentinel. A shared-state read racing
-        # another request's reset would surface as {} here.
+        # that request: the spec loop's acceptance numbers (solo or
+        # coalesced), or the sp_decode fallback sentinel. A shared-state read
+        # racing another request's reset would surface as {} here.
         assert (
             "verify_iterations" in spec
-            or spec.get("mode") in ("fallback", "coalesced_fallback")
+            or spec.get("mode") == "sp_decode_fallback"
         ), spec
