@@ -322,7 +322,7 @@ class LocalEngine:
         from collections import OrderedDict
 
         # value: (first_logits, prefix KVCache, prompt_len, np.int32 token ids,
-        #         seq_sharded — sp_decode entries are exact-hit-only)
+        #         seq_sharded — each layout continues only in its own layout)
         self._prefix_entries: "OrderedDict[Tuple[int, ...], Tuple[Any, KVCache, int, Any]]" = (
             OrderedDict()
         )
@@ -344,6 +344,7 @@ class LocalEngine:
 
         self._prefill_cache: Dict[Any, Any] = {}
         self._sp_prefill_cache: Dict[Any, Any] = {}
+        self._sp_continue_cache: Dict[Any, Any] = {}
         self._continue_cache: Dict[Any, Any] = {}
         self._decode_cache: Dict[Any, Any] = {}
         self._spec_decode_cache: Dict[Any, Any] = {}
@@ -444,6 +445,100 @@ class LocalEngine:
             self._sp_prefill_cache[bucket] = fn
         return fn
 
+    def _get_sp_continue(self, s_bucket: int, in_bucket: int, out_bucket: int):
+        """Jitted ring-layout continuation prefill (VERDICT r3 #6): suffix
+        tokens forward against an SP-resident prefix, suffix KV scattered into
+        the sequence-sharded layout — same (first_logits, prefix) contract as
+        the SP prefill, prefix at ``out_bucket``."""
+        key = (s_bucket, in_bucket, out_bucket)
+        fn = self._sp_continue_cache.get(key)
+        if fn is None:
+            from .long_context import forward_sp_continuation
+
+            mesh = self.mesh
+
+            def _cont(params, suffix_tokens, prefix, plen, total):
+                return forward_sp_continuation(
+                    self.config, params, suffix_tokens, prefix, mesh,
+                    plen, total, out_bucket, seq_axis=DATA_AXIS,
+                )
+
+            kv_spec = P(None, None, DATA_AXIS, MODEL_AXIS, None)
+            out_shardings = (
+                NamedSharding(mesh, P(None, None)),
+                KVCache(
+                    k=NamedSharding(mesh, kv_spec),
+                    v=NamedSharding(mesh, kv_spec),
+                ),
+            )
+            fn = jax.jit(_cont, out_shardings=out_shardings)
+            self._sp_continue_cache[key] = fn
+        return fn
+
+    def _sp_prefix_match(self, ids: List[int]) -> Tuple[Optional[KVCache], int]:
+        """Longest common token prefix across SEQUENCE-SHARDED cache entries
+        only (the ring-decode route's counterpart of _prefix_match — the two
+        layouts never cross-match; each route continues in its own layout)."""
+        return self._match_prefix_entries(ids, want_seq_sharded=True)
+
+    def _sp_prefill_routed(self, prompt_ids: List[int], prompt_len: int, bucket: int):
+        """SP-resident prefill through the prefix cache: exact hit -> zero
+        device work; partial hit past the reuse threshold -> ring-layout
+        continuation (suffix-only forward, O(S/P) per device throughout);
+        miss -> full sequence-parallel prefill. Stores the resulting
+        sequence-sharded entry either way."""
+        config = self.config
+        if not self.prefix_cache_size:
+            return self._prefill_full(prompt_ids, prompt_len, bucket)
+        key = tuple(prompt_ids)
+        hit = self._prefix_entries.get(key)
+        if hit is not None:
+            self._prefix_entries.move_to_end(key)
+            self.prefix_cache_stats["hits"] += 1
+            return hit[0], hit[1]
+
+        matched_kv, p = self._sp_prefix_match(prompt_ids)
+        if matched_kv is not None and p >= self.prefix_cache_min_reuse:
+            s_bucket = _bucket(max(1, prompt_len - p), minimum=32)
+            in_bucket = int(matched_kv.k.shape[2])
+            out_bucket = max(bucket, in_bucket)
+            ring = self.mesh.shape[DATA_AXIS]
+            # The suffix self-attention materializes a per-layer f32 score
+            # tensor [QH, Ssuf, Ssuf]; past the cap the full SP prefill is the
+            # better program (ring attention, O(S/P) scores).
+            continuation_ok = (
+                p + s_bucket <= config.max_seq_len
+                and out_bucket % ring == 0
+                and config.num_heads * s_bucket * s_bucket * 4
+                <= self.MAX_CONT_SCORE_BYTES
+            )
+            if continuation_ok:
+                self.prefix_cache_stats["partial_hits"] += 1
+                suffix = prompt_ids[p:]
+                suffix_tokens = jnp.array(
+                    [suffix + [config.pad_token_id] * (s_bucket - len(suffix))],
+                    jnp.int32,
+                )
+                first_logits, prefix = self._get_sp_continue(
+                    s_bucket, in_bucket, out_bucket
+                )(
+                    self.params, suffix_tokens, matched_kv,
+                    jnp.int32(p), jnp.int32(prompt_len),
+                )
+                self._prefix_store(
+                    prompt_ids, first_logits, prefix,
+                    seq_sharded=self._kv_seq_sharded(prefix),
+                )
+                return first_logits, prefix
+
+        self.prefix_cache_stats["misses"] += 1
+        first_logits, prefix = self._prefill_full(prompt_ids, prompt_len, bucket)
+        self._prefix_store(
+            prompt_ids, first_logits, prefix,
+            seq_sharded=self._kv_seq_sharded(prefix),
+        )
+        return first_logits, prefix
+
     # -- prefix cache ------------------------------------------------------
     def _get_prefill_continue(self, s_bucket: int, total_bucket: int):
         """Jitted suffix prefill: writes suffix KV into the reused prefix
@@ -496,14 +591,21 @@ class LocalEngine:
         matched entry's KV and the usable common length (capped below the new
         prompt's length so there is always >=1 suffix token to prefill).
 
-        Sequence-sharded entries (sp_decode) are exact-hit-only: the
-        replicated continuation prefill padding/slicing one would all-gather
-        the full O(S) prefix onto every device — the exact HBM spike the
-        sp_decode layout exists to avoid at long contexts."""
+        Sequence-sharded entries (sp_decode) are skipped: the REPLICATED
+        continuation prefill padding/slicing one would all-gather the full
+        O(S) prefix onto every device. They have their own continuation in
+        their own layout instead (_sp_prefix_match + _sp_prefill_routed)."""
+        return self._match_prefix_entries(ids, want_seq_sharded=False)
+
+    def _match_prefix_entries(
+        self, ids: List[int], want_seq_sharded: bool
+    ) -> Tuple[Optional[KVCache], int]:
+        """The shared longest-common-prefix scan over cache entries of ONE
+        layout (capping rules live here, once for both routes)."""
         ids_np = np.asarray(ids, np.int32)
         best_kv, best_p = None, 0
         for _, kv, plen, arr, seq_sharded in self._prefix_entries.values():
-            if seq_sharded:
+            if seq_sharded != want_seq_sharded:
                 continue
             limit = min(len(ids) - 1, plen)
             neq = np.flatnonzero(arr[:limit] != ids_np[:limit])
@@ -1450,11 +1552,10 @@ class LocalEngine:
         self.spec_stats = spec_stats
 
         # Ring-decode route (sp_decode): prompts taking the SP prefill keep
-        # their KV sequence-sharded and decode against it in place. Exact
-        # prefix-cache hits compose (the cached seq-sharded KV feeds the ring
-        # loop directly); partial-hit CONTINUATION does not — the suffix
-        # prefill writes into the replicated layout — so repeats re-prefill
-        # sequence-parallel instead.
+        # their KV sequence-sharded and decode against it in place. The
+        # prefix cache composes fully: exact hits feed the ring loop
+        # directly, and partial hits run the ring-layout continuation
+        # prefill (suffix-only forward, O(S/P) per device — r3 #6).
         sp_resident = (
             self.sp_decode
             and self.mesh is not None
@@ -1483,19 +1584,9 @@ class LocalEngine:
 
         req_keys = jnp.stack([jax.random.key(seed)])
         if sp_resident:
-            key = tuple(prompt_ids)
-            hit = self._prefix_entries.get(key) if self.prefix_cache_size else None
-            if hit is not None:
-                self._prefix_entries.move_to_end(key)
-                self.prefix_cache_stats["hits"] += 1
-                first_logits, prefix = hit[0], hit[1]
-            else:
-                first_logits, prefix = self._prefill_full(prompt_ids, prompt_len, bucket)
-                if self.prefix_cache_size:
-                    self.prefix_cache_stats["misses"] += 1
-                    self._prefix_store(
-                        prompt_ids, first_logits, prefix, seq_sharded=True
-                    )
+            first_logits, prefix = self._sp_prefill_routed(
+                prompt_ids, prompt_len, bucket
+            )
         else:
             first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
         loop = self._get_decode_loop(

@@ -204,3 +204,139 @@ def test_generate_many_with_sp_decode_prefix_cache_bit_equal():
     want = dense.generate_many(items, **kw)
     for g, w in zip(got, want):
         np.testing.assert_array_equal(g.tokens, w.tokens)
+
+
+# -- ring-layout continuation prefill (VERDICT r3 #6) ------------------------
+
+def test_sp_partial_hit_continues_in_ring_layout():
+    """A growing prompt re-using a cached SP-resident prefix must take the
+    ring-layout CONTINUATION (partial hit — no full re-prefill), produce a
+    sequence-sharded entry, and generate tokens bit-equal to the dense
+    engine's."""
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True,
+        prefix_cache_size=4, prefix_cache_min_reuse=16,
+    )
+    kw = dict(n=4, max_new_tokens=4, temperature=0.7, seed=13)
+
+    r1 = eng.generate(PROMPT, **kw)
+    assert eng.prefix_cache_stats == {"hits": 0, "partial_hits": 0, "misses": 1}
+    np.testing.assert_array_equal(r1.tokens, dense.generate(PROMPT, **kw).tokens)
+
+    longer = PROMPT + [int(x) for x in jax.random.randint(jax.random.key(7), (30,), 5, 200)]
+    r2 = eng.generate(longer, **kw)
+    assert eng.prefix_cache_stats["partial_hits"] == 1
+    assert eng.prefix_cache_stats["misses"] == 1  # no full re-prefill
+    np.testing.assert_array_equal(r2.tokens, dense.generate(longer, **kw).tokens)
+
+    # The continuation's entry is itself sequence-sharded and re-usable:
+    # a third, even longer prompt continues from IT.
+    entry = eng._prefix_entries[tuple(longer)]
+    assert entry[4] is True
+    assert entry[1].k.sharding.spec[2] == "data"
+    longest = longer + [int(x) for x in jax.random.randint(jax.random.key(8), (20,), 5, 200)]
+    r3 = eng.generate(longest, **kw)
+    assert eng.prefix_cache_stats["partial_hits"] == 2
+    assert eng.prefix_cache_stats["misses"] == 1
+    np.testing.assert_array_equal(r3.tokens, dense.generate(longest, **kw).tokens)
+
+
+def test_sp_continuation_crosses_bucket_boundary():
+    """Continuation where the longer prompt lands in a BIGGER bucket: the
+    stored prefix grows to the new bucket (sharded pad) and outputs stay
+    bit-equal to dense."""
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True,
+        prefix_cache_size=4, prefix_cache_min_reuse=16,
+    )
+    kw = dict(n=4, max_new_tokens=3, temperature=0.6, seed=29)
+    eng.generate(PROMPT, **kw)  # bucket 64
+    # 64 + 80 = 144 tokens -> bucket 256 > the entry's 64
+    longer = PROMPT + [int(x) for x in jax.random.randint(jax.random.key(3), (80,), 5, 200)]
+    r2 = eng.generate(longer, **kw)
+    assert eng.prefix_cache_stats["partial_hits"] == 1
+    np.testing.assert_array_equal(r2.tokens, dense.generate(longer, **kw).tokens)
+    assert eng._prefix_entries[tuple(longer)][1].k.shape[2] == 256
+
+
+def test_sp_continuation_logprobs_match_dense():
+    """Float agreement, not just greedy tokens: continuation-path logprobs
+    must match the dense engine's within tolerance."""
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True,
+        prefix_cache_size=2, prefix_cache_min_reuse=16,
+    )
+    kw = dict(n=2, max_new_tokens=4, temperature=0.0, seed=5)
+    eng.generate(PROMPT, **kw)
+    longer = PROMPT + [int(x) for x in jax.random.randint(jax.random.key(11), (25,), 5, 200)]
+    r = eng.generate(longer, **kw)
+    assert eng.prefix_cache_stats["partial_hits"] == 1
+    want = dense.generate(longer, **kw)
+    np.testing.assert_array_equal(r.tokens, want.tokens)
+    np.testing.assert_allclose(r.logprobs, want.logprobs, rtol=2e-4, atol=2e-4)
+
+
+def test_suffix_prefix_attention_matches_dense():
+    """(acc, m, l) from the one-psum suffix-vs-prefix op must reproduce plain
+    softmax attention over the valid prefix keys, for every suffix query."""
+    from k_llms_tpu.ops.ring_attention import suffix_prefix_attention
+
+    mesh = make_mesh(8, 1)
+    QH, KVH, D, S, Sq = 4, 2, 16, 64, 8
+    plen = 41
+    q = jax.random.normal(jax.random.key(1), (1, QH, Sq, D), jnp.float32)
+    pk = jax.random.normal(jax.random.key(2), (1, S, KVH, D), jnp.float32)
+    pv = jax.random.normal(jax.random.key(3), (1, S, KVH, D), jnp.float32)
+
+    acc, m, l = jax.jit(
+        lambda q, pk, pv: suffix_prefix_attention(mesh, q, pk, pv, jnp.int32(plen))
+    )(q, pk, pv)
+
+    G = QH // KVH
+    qg = np.asarray(q).reshape(1, KVH, G, Sq, D)
+    k = np.asarray(pk)[0]
+    v = np.asarray(pv)[0]
+    s = np.einsum("bhgqd,shd->bhgqs", qg, k) / np.sqrt(D)
+    s[..., plen:] = -np.inf
+    s = s.reshape(1, QH, Sq, S)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    ref_out = np.einsum(
+        "bhgqs,shd->bhgqd", (w / w.sum(-1, keepdims=True)).reshape(1, KVH, G, Sq, S), v
+    ).reshape(1, QH, Sq, D)
+    got = np.asarray(acc) / np.asarray(l)[..., None]
+    np.testing.assert_allclose(got, ref_out, rtol=2e-5, atol=2e-5)
+    # (m, l) is a valid logsumexp decomposition
+    lse = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1)
+    np.testing.assert_allclose(np.asarray(m) + np.log(np.asarray(l)), lse, rtol=1e-5, atol=1e-5)
+
+
+def test_scatter_into_ring_writes_only_suffix_rows():
+    from k_llms_tpu.ops.ring_attention import scatter_into_ring
+
+    mesh = make_mesh(8, 1)
+    S, Ssuf, KVH, D = 64, 16, 2, 4
+    base = jax.random.normal(jax.random.key(1), (1, S, KVH, D), jnp.float32)
+    suf = jax.random.normal(jax.random.key(2), (1, Ssuf, KVH, D), jnp.float32)
+    start, total = 37, 48  # 11 real suffix rows; rows 48.. stay untouched
+    out = jax.jit(
+        lambda b, s: scatter_into_ring(mesh, b, s, jnp.int32(start), jnp.int32(total))
+    )(base, suf)
+    out = np.asarray(out)
+    want = np.asarray(base).copy()
+    want[0, start:total] = np.asarray(suf)[0, : total - start]
+    np.testing.assert_array_equal(out, want)
