@@ -8,8 +8,11 @@ Parity targets in `/root/reference/k_llms/utils/consensus_utils.py`:
 The Levenshtein kernel is our native C++ (``k_llms_tpu.native``) instead of the
 python-Levenshtein wheel. Accent folding (the reference's ``unidecode``) is the
 first-party transliterator in ``translit.py``: unidecode-faithful tables for
-Latin/Cyrillic/Greek and a deterministic per-codepoint fallback for other
-scripts, so distinct non-Latin vote values never collapse into one bucket.
+Latin/Cyrillic/Greek/hanzi/kana, algorithmic Hangul, and a deterministic
+per-codepoint fallback for unmapped scripts.  Like the real unidecode, CJK
+romanization deliberately merges homophones (他/她/它 all vote as "Ta") —
+that collapse is reference behavior, not a bug; only the rare long tail keeps
+the distinct ``u<hex>`` tokens.
 """
 
 from __future__ import annotations

@@ -10,14 +10,20 @@ This module supplies the same behavior without the dependency:
   using unidecode's ALA-LC-style mappings (ж→zh, х→kh, щ→shch, ю→iu, я→ia, ...).
 * **Greek** — full alphabet incl. precomposed accents, unidecode's mappings
   (θ→th, ξ→x, φ→ph, χ→kh, ψ→ps, η→e, ...).
-* **Everything else non-Latin** (CJK, kana, Arabic, Hebrew, Indic, ...) — a
-  deterministic per-codepoint token ``u<hex>`` for alphanumeric characters.
-  This *diverges* from unidecode (which romanizes, e.g. 北京 → "Bei Jing ") but
-  preserves the property that matters for voting: distinct strings stay
-  distinct, so "東京" and "北京" never collapse into one vote bucket.  The only
-  observable difference vs the reference is that a romanized Latin spelling and
-  its native-script spelling do not share a bucket (unidecode would sometimes
-  merge them).
+* **Han ideographs** — unidecode-style pinyin for the high-frequency core
+  (~1,700 codepoints incl. traditional variants, ``_cjk_data.HANZI``):
+  北京 → "Bei Jing ", matching unidecode's capitalized-syllable-plus-space
+  format exactly.
+* **Kana** — full hiragana/katakana romaji tables (``_cjk_data.KANA``)
+  matching unidecode's x030 block: こんにちは → "konnichiha", カード → "ka-do".
+* **Hangul** — algorithmic jamo decomposition + Revised-Romanization letter
+  values: 서울 → "seoul", 안녕 → "annyeong".
+* **Remaining scripts / long-tail CJK** (Arabic, Hebrew, Indic, rare
+  ideographs beyond the frequency table, ...) — a deterministic per-codepoint
+  token ``u<hex>`` for alphanumeric characters.  This *diverges* from
+  unidecode (which carries full Unihan tables) but preserves the property that
+  matters for voting: distinct strings stay distinct, so two rare ideographs
+  never collapse into one vote bucket.
 
 Tables are hand-derived from unidecode's documented mapping set and pinned by
 the fixture vectors in ``tests/fixtures/unidecode_vectors.py``.
@@ -26,6 +32,8 @@ the fixture vectors in ``tests/fixtures/unidecode_vectors.py``.
 from __future__ import annotations
 
 import unicodedata
+
+from ._cjk_data import HANZI, KANA
 
 # Latin letters with no NFKD decomposition, mapped the way unidecode maps them.
 _LATIN = {
@@ -150,21 +158,57 @@ _TABLE: dict[int, str] = {
         **_LATIN,
         **_with_upper(_CYRILLIC_LOWER),
         **_with_upper(_GREEK_LOWER),
+        **KANA,
+        **HANZI,
     }.items()
 }
+
+# Hangul syllables (U+AC00..U+D7A3) decompose arithmetically into
+# (initial, medial, final) jamo; romanize with Revised-Romanization letter
+# values (서울 → "seoul").  Index order follows the Unicode syllable algorithm.
+_HANGUL_BASE = 0xAC00
+_HANGUL_LAST = 0xD7A3
+_HANGUL_INITIALS = (
+    "g", "kk", "n", "d", "tt", "r", "m", "b", "pp", "s", "ss", "", "j", "jj",
+    "ch", "k", "t", "p", "h",
+)
+_HANGUL_MEDIALS = (
+    "a", "ae", "ya", "yae", "eo", "e", "yeo", "ye", "o", "wa", "wae", "oe",
+    "yo", "u", "wo", "we", "wi", "yu", "eu", "ui", "i",
+)
+_HANGUL_FINALS = (
+    "", "g", "kk", "gs", "n", "nj", "nh", "d", "l", "lg", "lm", "lb", "ls",
+    "lt", "lp", "lh", "m", "b", "bs", "s", "ss", "ng", "j", "ch", "k", "t",
+    "p", "h",
+)
+
+
+def _hangul_romanize(cp: int) -> str:
+    idx = cp - _HANGUL_BASE
+    initial, rest = divmod(idx, 21 * 28)
+    medial, final = divmod(rest, 28)
+    return _HANGUL_INITIALS[initial] + _HANGUL_MEDIALS[medial] + _HANGUL_FINALS[final]
 
 
 def transliterate(text: str) -> str:
     """unidecode-equivalent ASCII transliteration.
 
-    Pipeline: mapped-script table → NFKD decomposition → per-char sweep that
-    keeps ASCII, drops combining marks, maps non-ASCII decimal digits to their
-    ASCII digit (unidecode parity), and tokenizes any remaining alphanumeric
-    codepoint as ``u<hex>`` so unmapped scripts stay distinct.
+    Pipeline: mapped-script table (Latin specials, Cyrillic, Greek, kana,
+    hanzi) → algorithmic Hangul romanization → NFKD decomposition → per-char
+    sweep that keeps ASCII, drops combining marks, maps non-ASCII decimal
+    digits to their ASCII digit (unidecode parity), and tokenizes any
+    remaining alphanumeric codepoint as ``u<hex>`` so unmapped scripts stay
+    distinct.  Hangul runs before NFKD because NFKD shatters syllables into
+    conjoining jamo.
     """
     if text.isascii():
         return text
     text = text.translate(_TABLE)
+    if any(_HANGUL_BASE <= ord(ch) <= _HANGUL_LAST for ch in text):
+        text = "".join(
+            _hangul_romanize(cp) if _HANGUL_BASE <= (cp := ord(ch)) <= _HANGUL_LAST else ch
+            for ch in text
+        )
     decomposed = unicodedata.normalize("NFKD", text)
     out: list[str] = []
     for ch in decomposed:

@@ -2,10 +2,13 @@
 documented mapping set (the wheel itself is not installed in this image).
 
 ``PARITY_VECTORS`` are pairs our ``transliterate`` must reproduce exactly —
-Latin specials, Cyrillic, Greek.  ``DIVERGENT_VECTORS`` are pairs where the
-real unidecode romanizes (CJK pinyin) but our transliterator intentionally
-emits per-codepoint ``u<hex>`` tokens instead; tests assert the documented
-divergence (distinctness preserved, romanization not attempted).
+Latin specials, Cyrillic, Greek, and (since round 5) CJK: hanzi pinyin, kana
+romaji, and Hangul.  ``DIVERGENT_VECTORS`` are pairs where the real unidecode
+romanizes but our transliterator intentionally emits per-codepoint ``u<hex>``
+tokens instead; since round 5 that remainder is only the long tail of rare
+ideographs outside the ~1,700-codepoint frequency table in
+``k_llms_tpu/consensus/_cjk_data.py``.  Tests assert the documented divergence
+(distinctness preserved, romanization not attempted).
 
 Used by ``tests/reference_oracle.py`` to stub the reference's ``unidecode``
 import faithfully: fixture hits return the REAL unidecode output, so parity
@@ -47,14 +50,37 @@ PARITY_VECTORS: list[tuple[str, str]] = [
     ("Ξάνθη", "Xanthe"),
     ("χάος", "khaos"),
     ("σοφός", "sophos"),
+    # Han ideographs (unidecode emits "Syllable " per character)
+    ("北京", "Bei Jing "),
+    ("東京", "Dong Jing "),
+    ("上海", "Shang Hai "),
+    ("中国", "Zhong Guo "),
+    ("日本", "Ri Ben "),
+    ("你好", "Ni Hao "),
+    ("汉字", "Han Zi "),
+    ("漢字", "Han Zi "),
+    ("日本語", "Ri Ben Yu "),
+    # Kana (lowercase romaji, no separators; unidecode's famous quirks kept:
+    # は stays "ha" even as a particle, small っ is "tsu", ー is "-")
+    ("こんにちは", "konnichiha"),
+    ("ひらがな", "hiragana"),
+    ("カタカナ", "katakana"),
+    ("カード", "ka-do"),
+    ("サッカー", "satsuka-"),
+    # Hangul (algorithmic jamo decomposition, RR letter values)
+    ("서울", "seoul"),
+    ("안녕", "annyeong"),
 ]
 
-# (input, real unidecode output, our transliterate output = per-codepoint tokens)
+# (input, real unidecode output, our transliterate output = per-codepoint
+# tokens).  Long-tail ideographs outside the frequency table: real unidecode
+# carries full Unihan tables and still romanizes these; we keep them distinct
+# via u<hex> tokens instead.
 DIVERGENT_VECTORS: list[tuple[str, str, str]] = [
     (inp, real, "".join(f"u{ord(c):04x}" for c in inp))
     for inp, real in [
-        ("北京", "Bei Jing "),
-        ("東京", "Dong Jing "),
+        ("麤", "Cu "),   # U+9EA4 'coarse' (triple deer) — rare tail
+        ("羴", "Shan "),  # U+7FB4 'rank odor of sheep' — rare tail
     ]
 ]
 

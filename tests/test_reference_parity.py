@@ -231,14 +231,21 @@ UNICODE = [
     "Ångström Σigma ñandú",
     "日本語テキストの抽出フィールド",
     "zażółć gęślą jaźń",
-    # fixture-covered Cyrillic/Greek: the oracle's unidecode stub returns REAL
-    # unidecode output for these (fixtures/unidecode_vectors.py), so parity
-    # here is against genuine reference sanitization, not our own fold.
+    # fixture-covered Cyrillic/Greek/CJK: the oracle's unidecode stub returns
+    # REAL unidecode output for these (fixtures/unidecode_vectors.py), so
+    # parity here is against genuine reference sanitization, not our own fold.
     "Москва",
     "Санкт-Петербург",
     "объект",
     "Αθήνα",
     "Θεσσαλονίκη",
+    "北京",
+    "東京",
+    "上海",
+    "你好",
+    "こんにちは",
+    "カタカナ",
+    "서울",
 ]
 GNARLY_SCALARS = [
     "", None, 0, 0.0, False, True, "42", 42, -0.0, 1e-9, 1e12,

@@ -2,7 +2,9 @@
 
 Pins `k_llms_tpu/consensus/translit.py` to the reference's sanitization
 behavior (`/root/reference/k_llms/utils/consensus_utils.py:15,925-933`) on
-Latin/Cyrillic/Greek, and documents the intentional CJK divergence.
+Latin/Cyrillic/Greek and (since round 5) CJK — hanzi pinyin, kana romaji,
+Hangul — and documents the remaining intentional divergence on rare
+long-tail ideographs.
 """
 
 import pytest
@@ -20,11 +22,26 @@ def test_parity_with_real_unidecode(inp, expected):
 
 
 @pytest.mark.parametrize("inp,real,ours", DIVERGENT_VECTORS, ids=[v[0] for v in DIVERGENT_VECTORS])
-def test_documented_cjk_divergence(inp, real, ours):
-    # real unidecode romanizes; we emit per-codepoint tokens (distinctness only)
+def test_documented_long_tail_divergence(inp, real, ours):
+    # real unidecode romanizes even rare tail ideographs (full Unihan tables);
+    # we emit per-codepoint tokens for them (distinctness only)
     got = transliterate(inp)
     assert got == ours
     assert got != real  # the divergence is intentional and documented
+
+
+def test_cjk_vote_keys_match_reference_pipeline():
+    # The reference sanitizes str(v).lower().replace(" ","") -> unidecode ->
+    # strip non-alnum (consensus_utils.py:925-933).  lower() precedes the
+    # romanization, so pinyin capitals survive into the vote key.
+    assert sanitize_value("北京") == "BeiJing"
+    assert sanitize_value("東京") == "DongJing"
+    assert sanitize_value("こんにちは") == "konnichiha"
+    assert sanitize_value("서울") == "seoul"
+    # Native-script and romanized spellings of the same name now produce
+    # vote keys with identical letters (case differs exactly as it would
+    # under the reference's pipeline, which also lowercases *before* folding).
+    assert sanitize_value("北京").lower() == sanitize_value("Beijing")
 
 
 def test_ascii_fold_is_transliterate():
