@@ -7,6 +7,9 @@ import threading
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Union
 
+from ..reliability import failpoints as _failpoints
+from ..reliability.deadline import RequestBudget
+from ..reliability.retry import CircuitBreaker, RetryPolicy
 from ..types import ChatCompletion
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -35,6 +38,10 @@ class ChatRequest:
     # at sampling time (the reference forwards it to the server; the local
     # engine applies it in the decode loop).
     logit_bias: Optional[Dict[str, float]] = None
+    # Lifecycle budget built from the caller's ``timeout=`` (deadline) plus a
+    # cooperative cancel token; threaded into scheduler admission and the
+    # engine decode loop. None = unbounded (the reference's no-timeout default).
+    budget: Optional[RequestBudget] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -44,6 +51,47 @@ class Backend(abc.ABC):
     @abc.abstractmethod
     def chat_completion(self, request: ChatRequest) -> ChatCompletion:
         """Return ONE ChatCompletion carrying n choices (the n samples)."""
+
+    #: Dispatch-layer reliability knobs, overridable per instance (pass a
+    #: seeded RetryPolicy in tests to pin backoff schedules). The breaker is
+    #: lazily per-instance so one flapping backend never opens another's
+    #: circuit.
+    retry_policy: RetryPolicy = RetryPolicy()
+
+    @property
+    def circuit_breaker(self) -> CircuitBreaker:
+        breaker = self.__dict__.get("_circuit_breaker")
+        if breaker is None:
+            breaker = CircuitBreaker(name=type(self).__name__)
+            self.__dict__["_circuit_breaker"] = breaker
+        return breaker
+
+    def dispatch_chat_completion(self, request: ChatRequest) -> ChatCompletion:
+        """``chat_completion`` wrapped in the reliability layer: circuit-breaker
+        gate, budget check, bounded retry with backoff (the shape the reference
+        inherits from the OpenAI client's 2-retry exponential backoff, and that
+        bench.py's relay-flap probes proved locally), plus the
+        ``backend.dispatch`` failpoint. This is what the resources layer calls;
+        ``chat_completion`` stays the single-attempt primitive."""
+        breaker = self.circuit_breaker
+
+        def attempt() -> ChatCompletion:
+            from ..types.wire import RequestCancelledError, RequestTimeoutError
+
+            breaker.allow()
+            try:
+                _failpoints.fire("backend.dispatch")
+                out = self.chat_completion(request)
+            except BaseException as e:
+                # A caller's own deadline/cancel is not a backend-health
+                # signal — only genuine dispatch faults trip the circuit.
+                if not isinstance(e, (RequestTimeoutError, RequestCancelledError)):
+                    breaker.record_failure()
+                raise
+            breaker.record_success()
+            return out
+
+        return self.retry_policy.call(attempt, budget=request.budget)
 
     @abc.abstractmethod
     def embeddings(self, texts: List[str]) -> List[List[float]]:

@@ -256,11 +256,29 @@ class TpuBackend(Backend):
             presence_penalty=float(request.presence_penalty or 0.0),
             logit_bias=logit_bias,
             stop_sequences=stop_seqs,
+            budget=request.budget,
         )
 
         choices: List[Dict[str, Any]] = []
         completion_tokens = 0
         for i in range(n):
+            err = result.sample_errors[i] if result.sample_errors else None
+            if err is not None:
+                # Sample lost mid-decode (fault or injected kill): an empty-
+                # content choice already drops out of the consensus vote; the
+                # ``sample_error`` extension lets consolidation count the loss
+                # and emit the response-level ``degraded`` marker.
+                choices.append(
+                    {
+                        "finish_reason": "stop",
+                        "index": i,
+                        "message": {"role": "assistant", "content": ""},
+                        "logprobs": None,
+                        "sample_logprob": 0.0,
+                        "sample_error": dict(err),
+                    }
+                )
+                continue
             length = int(result.lengths[i])
             ids = [int(t) for t in result.tokens[i][:length]]
             text = tok.decode(ids)
@@ -370,10 +388,15 @@ class TpuBackend(Backend):
         presence_penalty: float = 0.0,
         logit_bias: Optional[Dict[int, float]] = None,
         stop_sequences: Optional[List[List[int]]] = None,
+        budget=None,
     ):
         """Submit one generation through the coalescing scheduler: concurrent
         requests with the same sampling config decode as ONE batched XLA
-        program (`LocalEngine.generate_many`); a lone request runs solo."""
+        program (`LocalEngine.generate_many`); a lone request runs solo.
+        ``budget`` rides both the scheduler item (admission control, window
+        bounding, queue shedding) and the GenRequestSpec (decode-loop
+        cancellation); it is NOT part of the batch_key — different deadlines
+        still coalesce."""
         from ..engine.engine import GenRequestSpec
 
         ckey = None
@@ -416,7 +439,11 @@ class TpuBackend(Backend):
         dp = self.engine.data_parallel_size
         rows = ((max(1, n) + dp - 1) // dp) * dp
         return self.scheduler.call_batched(
-            batch_key, GenRequestSpec(list(prompt_ids), n, seed), run, weight=rows
+            batch_key,
+            GenRequestSpec(list(prompt_ids), n, seed, budget),
+            run,
+            weight=rows,
+            budget=budget,
         )
 
     def _constraint_for(self, response_format: Any):

@@ -84,6 +84,7 @@ class _BaseKLLMs:
         self,
         backend: Union[str, Backend, None] = None,
         model: Optional[str] = None,
+        timeout: Optional[float] = None,
         **backend_kwargs: Any,
     ):
         # When WE construct the backend from a name, the client-level model
@@ -98,6 +99,11 @@ class _BaseKLLMs:
         self.default_model = (
             model or getattr(self._backend, "model_name", None) or "llama-3-8b"
         )
+        # Client-level deadline default in seconds (the OpenAI client's
+        # ``timeout=`` constructor knob); per-call ``timeout=`` overrides it.
+        # None = unbounded, matching the reference's behavior of leaving
+        # timeouts entirely to the SDK default.
+        self.default_timeout = timeout
 
     @property
     def backend(self) -> Backend:

@@ -21,7 +21,10 @@ from typing import Any, Dict, List, Optional, Type, Union
 
 from pydantic import BaseModel
 
+from ..reliability import failpoints as _failpoints
+from ..reliability.deadline import RequestBudget
 from ..types import (
+    BackendUnavailableError,
     ChatCompletion,
     ChatCompletionMessage,
     Choice,
@@ -30,7 +33,9 @@ from ..types import (
     ParsedChatCompletion,
     ParsedChatCompletionMessage,
     ParsedChoice,
+    RequestTimeoutError,
 )
+from ..utils.observability import FAILURE_EVENTS
 from .primitive import LlmConsensusFn
 from .recursion import consensus_values, recursive_list_alignments
 from .settings import ConsensusSettings
@@ -165,17 +170,77 @@ def _consensus_with_degrade(
     return None, None
 
 
+def _degraded_info(choices) -> Optional[Dict[str, Any]]:
+    """Partial-failure accounting from the backend's per-choice
+    ``sample_error`` extensions (samples lost mid-decode to a fault, abort,
+    or injected kill). None when every sample is healthy. Distinct from a
+    sample that merely returned EMPTY content — that is a model outcome, not
+    a failure, and must not trigger degraded marking or likelihood scaling."""
+    errors: List[Dict[str, Any]] = []
+    for i, choice in enumerate(choices):
+        err = getattr(choice, "sample_error", None)
+        if err:
+            errors.append({"sample_index": i, **dict(err)})
+    if not errors:
+        return None
+    requested = len(choices)
+    survived = requested - len(errors)
+    return {
+        "requested": requested,
+        "survived": survived,
+        "survival_fraction": survived / requested,
+        "sample_errors": errors,
+    }
+
+
+def _raise_if_no_survivors(
+    degraded: Optional[Dict[str, Any]], budget: Optional[RequestBudget]
+) -> None:
+    """Zero survivors is not a consensus, it is a failure: raise the typed
+    error that best describes WHY (caller's budget verdict wins; otherwise
+    homogeneous timeout losses surface as timeout, anything else as a
+    backend fault)."""
+    if degraded is None or degraded["survived"] > 0:
+        return
+    FAILURE_EVENTS.record("consensus.zero_survivors")
+    if budget is not None and budget.should_abort():
+        raise budget.error("consolidation")
+    codes = {e.get("code") for e in degraded["sample_errors"]}
+    n = degraded["requested"]
+    if codes <= {"request_timeout"}:
+        raise RequestTimeoutError(f"all {n} samples timed out before completing")
+    raise BackendUnavailableError(f"all {n} samples failed during generation")
+
+
+def _scale_tree(node: Any, frac: float) -> Any:
+    """Scale every confidence in a likelihoods tree by the survival fraction:
+    agreement among r of n requested samples is weaker evidence than the same
+    agreement among all n, and the scores must say so."""
+    if isinstance(node, dict):
+        return {k: _scale_tree(v, frac) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_scale_tree(v, frac) for v in node]
+    if isinstance(node, (int, float)) and not isinstance(node, bool):
+        return float(node) * frac
+    return node
+
+
 def consolidate_chat_completions(
     completions: Union[List[ChatCompletion], ChatCompletion],
     scorer: SimilarityScorer,
     consensus_settings: ConsensusSettings = ConsensusSettings(),
     llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    budget: Optional[RequestBudget] = None,
 ) -> KLLMsChatCompletion:
     """Consolidate one multi-choice completion (or a list of completions) into a
     KLLMsChatCompletion: choices[0] = consensus, choices[1..n] = originals."""
+    _failpoints.fire("consensus.consolidate")
     if isinstance(completions, ChatCompletion):
         completion = completions
         assert len(completion.choices) > 0, "Cannot consolidate empty list of choices"
+
+        degraded = _degraded_info(completion.choices)
+        _raise_if_no_survivors(degraded, budget)
 
         if len(completion.choices) == 1:
             return KLLMsChatCompletion.model_validate(completion.model_dump())
@@ -201,13 +266,25 @@ def consolidate_chat_completions(
             weights=_sample_weights(completion.choices, used_mask),
         )
 
+        if degraded is not None and isinstance(likelihoods, dict):
+            likelihoods = _scale_tree(likelihoods, degraded["survival_fraction"])
+
         return _rebuild_completion(
-            completion, list(enumerate(completion.choices)), consensus_content, likelihoods
+            completion,
+            list(enumerate(completion.choices)),
+            consensus_content,
+            likelihoods,
+            degraded=degraded,
         )
 
     # List-of-completions form: one sample per completion's first choice.
     completion_list = completions
     assert len(completion_list) > 0, "Cannot consolidate empty list of completions"
+
+    degraded = _degraded_info(
+        [c.choices[0] for c in completion_list if c.choices]
+    )
+    _raise_if_no_survivors(degraded, budget)
 
     if len(completion_list) == 1:
         return KLLMsChatCompletion.model_validate(completion_list[0].model_dump())
@@ -229,11 +306,15 @@ def consolidate_chat_completions(
         llm_consensus_fn,
     )
 
+    if degraded is not None and isinstance(likelihoods, dict):
+        likelihoods = _scale_tree(likelihoods, degraded["survival_fraction"])
+
     return _rebuild_completion(
         completion_list[0],
         [(i, c.choices[0]) for i, c in enumerate(completion_list) if c.choices],
         consensus_content,
         likelihoods,
+        degraded=degraded,
     )
 
 
@@ -248,6 +329,7 @@ def _rebuild_completion(
     result_cls=KLLMsChatCompletion,
     parsed=None,
     include_parsed: bool = False,
+    degraded: Optional[Dict[str, Any]] = None,
 ):
     """Assemble the wire-contract result shared by every consolidation shape:
     choices[0] = the consensus, rebuilt around the base choice's metadata
@@ -282,6 +364,7 @@ def _rebuild_completion(
             **base_completion.model_dump(),
             "choices": [c.model_dump() for c in [consolidated_choice] + individual_choices],
             "likelihoods": likelihoods,
+            "degraded": degraded,
             "usage": base_completion.usage.model_dump() if base_completion.usage else None,
         }
     )
@@ -293,10 +376,15 @@ def consolidate_parsed_chat_completions(
     consensus_settings: ConsensusSettings = ConsensusSettings(),
     response_format: Optional[Type[BaseModel]] = None,
     llm_consensus_fn: Optional[LlmConsensusFn] = None,
+    budget: Optional[RequestBudget] = None,
 ) -> KLLMsParsedChatCompletion:
     """Structured-output variant: the consensus dict is re-validated into the
     user's ``response_format`` model; ``parsed`` is silently None on failure."""
+    _failpoints.fire("consensus.consolidate")
     assert len(completion.choices) > 0, "Cannot consolidate empty list of choices"
+
+    degraded = _degraded_info(completion.choices)
+    _raise_if_no_survivors(degraded, budget)
 
     if len(completion.choices) == 1:
         result = KLLMsParsedChatCompletion.model_validate(completion.model_dump())
@@ -324,6 +412,9 @@ def consolidate_parsed_chat_completions(
         weights=_sample_weights(completion.choices, used_mask),
     )
 
+    if degraded is not None and isinstance(likelihoods, dict):
+        likelihoods = _scale_tree(likelihoods, degraded["survival_fraction"])
+
     parsed_consensus = None
     if response_format and consensus_content is not None:
         try:
@@ -342,6 +433,7 @@ def consolidate_parsed_chat_completions(
         result_cls=KLLMsParsedChatCompletion,
         parsed=parsed_consensus,
         include_parsed=True,
+        degraded=degraded,
     )
     # model_dump flattened `parsed` to a dict; restore the validated model object
     # on the consensus choice (the reference keeps the live object because openai's

@@ -200,9 +200,18 @@ def transliterate(text: str) -> str:
     remaining alphanumeric codepoint as ``u<hex>`` so unmapped scripts stay
     distinct.  Hangul runs before NFKD because NFKD shatters syllables into
     conjoining jamo.
+
+    NFD input (e.g. text from macOS filenames or some normalizing pipelines)
+    arrives already shattered into conjoining jamo (U+1100–U+11FF), which the
+    syllable-range romanizer cannot see; NFC composes those runs back into
+    precomposed syllables first, so NFD '서울' romanizes to 'seoul' exactly
+    like its NFC form (real unidecode romanizes the jamo block directly, so
+    parity holds either way).
     """
     if text.isascii():
         return text
+    if any(0x1100 <= ord(ch) <= 0x11FF for ch in text):
+        text = unicodedata.normalize("NFC", text)
     text = text.translate(_TABLE)
     if any(_HANGUL_BASE <= ord(ch) <= _HANGUL_LAST for ch in text):
         text = "".join(

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random as _pyrandom
 from functools import partial
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -27,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.experimental import io_callback
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -44,6 +46,9 @@ from ..models.llama import (
 from ..ops.sampling import model_top_logprobs, sample_logits
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, auto_mesh
 from ..parallel.sharding import batch_spec, cache_specs, param_specs
+from ..reliability import failpoints as _failpoints
+from ..reliability.deadline import RequestBudget
+from ..utils.observability import FAILURE_EVENTS
 
 logger = logging.getLogger(__name__)
 
@@ -125,6 +130,11 @@ class GenerationResult(NamedTuple):
     # (engine.spec_stats mirrors the most recent request for convenience, but
     # is shared mutable state — concurrent tracing must read this field).
     spec_stats: Optional[Dict[str, Any]] = None
+    # Per-sample failure records (index-aligned with tokens rows): None for a
+    # healthy sample, an error dict for one lost mid-decode (injected fault or
+    # per-sample abort). Consolidation drops failed samples from the vote and
+    # surfaces them in the response's `degraded` marker.
+    sample_errors: Optional[List[Optional[Dict[str, Any]]]] = None
 
 
 class GenRequestSpec(NamedTuple):
@@ -133,6 +143,25 @@ class GenRequestSpec(NamedTuple):
     prompt_ids: List[int]
     n: int = 1
     seed: Optional[int] = None
+    # Lifecycle budget (deadline + cancel token). NOT part of the scheduler's
+    # batch_key — requests with different deadlines still coalesce; each row
+    # group aborts independently via the decode loop's cancellation poll.
+    budget: Optional[RequestBudget] = None
+
+
+def _kill_sample_errors(n: int, fp: "_failpoints.FailSpec") -> List[Optional[Dict[str, Any]]]:
+    """Seeded selection of which of a request's n samples an injected
+    ``engine.decode`` kill_samples failpoint loses."""
+    rng = _pyrandom.Random(fp.seed)
+    idx = rng.sample(range(n), min(fp.kill, n))
+    errs: List[Optional[Dict[str, Any]]] = [None] * n
+    for i in idx:
+        errs[i] = {
+            "type": "server_error",
+            "code": "decode_fault",
+            "message": "sample lost mid-decode (injected failpoint engine.decode)",
+        }
+    return errs
 
 
 def _bucket(n: int, minimum: int = 32) -> int:
@@ -712,6 +741,72 @@ class LocalEngine:
         return self._prefill_full(prompt_ids, prompt_len, bucket)
 
     # -- decode loop ------------------------------------------------------
+    # -- cancellation plumbing --------------------------------------------
+    def _poll_abort_flags(self, num_requests: int) -> np.ndarray:
+        """[R] bool: which active requests' budgets are spent. Reads
+        ``_active_budgets`` (set around each decode by generate/generate_many;
+        safe shared state — the scheduler serializes device work). Padding
+        rows beyond the budget list never abort."""
+        budgets = getattr(self, "_active_budgets", None) or []
+        out = np.zeros((num_requests,), np.bool_)
+        for i, b in enumerate(budgets[:num_requests]):
+            if b is not None and b.should_abort():
+                out[i] = True
+        return out
+
+    def _abort_poller(self, num_requests: int):
+        """Host-side budget poll as a jit-safe callable for the decode loops.
+        The callback closes over ``self`` (NOT a specific budget), so compiled
+        loops cached across requests always read the current request's state.
+        ``step`` is a data dependency only — it pins the callback inside the
+        while_loop body so XLA cannot hoist or CSE it out."""
+
+        def _host_poll(step):
+            del step
+            return self._poll_abort_flags(num_requests)
+
+        def poll(step):
+            return io_callback(
+                _host_poll,
+                jax.ShapeDtypeStruct((num_requests,), jnp.bool_),
+                step,
+                ordered=False,
+            )
+
+        return poll
+
+    def _apply_decode_faults(
+        self, result: GenerationResult, budget: Optional[RequestBudget]
+    ) -> GenerationResult:
+        """Post-decode fault surfacing for ONE request: a spent budget raises
+        its typed lifecycle error (the decode loop already froze the rows);
+        an active ``engine.decode`` kill_samples failpoint marks a seeded
+        subset of samples lost (tokens cleared, ``sample_errors`` filled) so
+        the partial-failure consensus path is exercisable without real
+        device faults."""
+        if budget is not None and budget.should_abort():
+            FAILURE_EVENTS.record("engine.decode_abort")
+            raise budget.error("engine decode")
+        fp = _failpoints.fire("engine.decode")
+        if fp is None or fp.action != "kill_samples" or fp.kill <= 0:
+            return result
+        n = result.tokens.shape[0]
+        errs = _kill_sample_errors(n, fp)
+        killed = [i for i, e in enumerate(errs) if e is not None]
+        if not killed:
+            return result
+        FAILURE_EVENTS.record("engine.samples_killed", len(killed))
+        toks = result.tokens.copy()
+        lps = result.logprobs.copy()
+        lengths = result.lengths.copy()
+        for i in killed:
+            toks[i, :] = self.config.pad_token_id
+            lps[i, :] = 0.0
+            lengths[i] = 0
+        return result._replace(
+            tokens=toks, logprobs=lps, lengths=lengths, sample_errors=errs
+        )
+
     def _get_decode_loop(
         self,
         num_requests: int,
@@ -727,6 +822,7 @@ class LocalEngine:
         use_logit_bias: bool = False,
         use_stops: bool = False,
         sp_prefix: bool = False,
+        use_cancel: bool = False,
     ):
         """Jitted decode loop for R requests × n_per samples each (R=1 is the
         single-request case; R>1 is the cross-request coalesced batch).
@@ -750,7 +846,7 @@ class LocalEngine:
         cache_key = (
             num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
             top_logprobs, frequency_penalty, presence_penalty, use_logit_bias,
-            use_stops, sp_prefix,
+            use_stops, sp_prefix, use_cancel,
         )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
@@ -763,6 +859,8 @@ class LocalEngine:
         cops = _constraint_ops(constraint)
         if cops is not None:
             jt, initial_state, mask_logits, advance = cops
+
+        abort_poll = self._abort_poller(R) if use_cancel else None
 
         def _row_keys(req_keys, step):
             # fold_in(fold_in(req_key, step), row_within_request): with R=1
@@ -911,6 +1009,13 @@ class LocalEngine:
                 if use_stops:
                     recent = jnp.concatenate([recent[:, 1:], nxt[:, None]], axis=1)
                     done = jnp.logical_or(done, _stop_match(recent))
+                if use_cancel:
+                    # Token-granularity cancellation: an unordered host
+                    # callback polls each request's budget between steps;
+                    # aborted requests' row groups freeze like eos rows
+                    # (rows are request-major, hence the n_per repeat).
+                    aborted = abort_poll(step)
+                    done = jnp.logical_or(done, jnp.repeat(aborted, n_per))
                 return (step + 1, nxt, done, cache, toks, lps, tt, tl, counts, jst, recent)
 
             state = (
@@ -942,6 +1047,7 @@ class LocalEngine:
         presence_penalty: float = 0.0,
         use_logit_bias: bool = False,
         use_stops: bool = False,
+        use_cancel: bool = False,
     ):
         """Jitted prompt-lookup speculative loop for R requests x n_per rows
         (R=1 is the solo case; R>1 the cross-request coalesced batch, each
@@ -979,7 +1085,7 @@ class LocalEngine:
         cache_key = (
             "spec", num_requests, n_per, max_new, temperature, top_p, top_k, K,
             bucket, constraint_key, top_logprobs, frequency_penalty,
-            presence_penalty, use_logit_bias, use_stops,
+            presence_penalty, use_logit_bias, use_stops, use_cancel,
         )
         fn = self._spec_decode_cache.get(cache_key)
         if fn is not None:
@@ -1001,6 +1107,7 @@ class LocalEngine:
             jt, initial_state, mask_logits, advance = cops
         penalized = frequency_penalty != 0.0 or presence_penalty != 0.0
         KT = top_logprobs or 0
+        abort_poll = self._abort_poller(R) if use_cancel else None
 
         def _row_keys(req_keys, step_id):
             # fold(req key, step) then row-WITHIN-request: a request's sampling
@@ -1241,6 +1348,12 @@ class LocalEngine:
                 count = count + counts_new
                 hit_eos_any = hit_eos_any | hit_eos | stop_hit
                 done = done | hit_eos | stop_hit | (count >= max_new)
+                if use_cancel:
+                    # Same between-step cancellation poll as the normal loop
+                    # (see _abort_poller); one verify block may still complete
+                    # after expiry — cancellation is block-granular here.
+                    aborted = abort_poll(it)
+                    done = done | jnp.repeat(aborted, n_per)
                 return (
                     it + 1, count, done, hit_eos_any, row_iters, cache, toks, lps,
                     tt, tlb, vcounts, jst, recent,
@@ -1283,6 +1396,7 @@ class LocalEngine:
         logit_bias: Optional[Dict[int, float]] = None,
         stop_arr: Optional[jax.Array] = None,
         use_stops: bool = False,
+        budget: Optional[RequestBudget] = None,
     ) -> GenerationResult:
         config = self.config
         first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
@@ -1294,17 +1408,22 @@ class LocalEngine:
             constraint, top_logprobs, frequency_penalty, presence_penalty,
             use_logit_bias=logit_bias is not None,
             use_stops=use_stops,
+            use_cancel=budget is not None,
         )
-        toks, lps, hit_eos, count, row_iters, tt, tl = loop(
-            self.params, prefix, prompt_buf, jnp.array([prompt_len], jnp.int32),
-            first_logits, jnp.stack([jax.random.key(seed)]), eos_arr,
-            self._bias_array(logit_bias),
-            stop_arr if stop_arr is not None else self._stop_array(None)[0],
-        )
-        toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
-            np.asarray,
-            jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
-        )
+        self._active_budgets = [budget]
+        try:
+            toks, lps, hit_eos, count, row_iters, tt, tl = loop(
+                self.params, prefix, prompt_buf, jnp.array([prompt_len], jnp.int32),
+                first_logits, jnp.stack([jax.random.key(seed)]), eos_arr,
+                self._bias_array(logit_bias),
+                stop_arr if stop_arr is not None else self._stop_array(None)[0],
+            )
+            toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
+                np.asarray,
+                jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
+            )
+        finally:
+            self._active_budgets = None
         toks_np, lps_np, eos_np = toks_np[:n], lps_np[:n], eos_np[:n]
         spec_stats = _spec_acceptance_stats(count_np[:n], iters_np[:n])
         self.spec_stats = spec_stats
@@ -1328,6 +1447,7 @@ class LocalEngine:
         constraint, top_logprobs, frequency_penalty, presence_penalty,
         logit_bias, use_stops, stop_arr, eos_arr, r_pad, bucket_max,
         prefix, prompt_bufs, prompt_lens, first_logits, req_keys,
+        use_cancel=False,
     ) -> List[GenerationResult]:
         """generate_many's speculative tail: run the R-request spec loop and
         slice per-request results + acceptance stats (VERDICT r3 #5)."""
@@ -1337,15 +1457,20 @@ class LocalEngine:
             constraint, top_logprobs, frequency_penalty, presence_penalty,
             use_logit_bias=logit_bias is not None,
             use_stops=use_stops,
+            use_cancel=use_cancel,
         )
-        toks, lps, hit_eos, count, row_iters, tt, tl = loop(
-            self.params, prefix, prompt_bufs, prompt_lens, first_logits,
-            req_keys, eos_arr, self._bias_array(logit_bias), stop_arr,
-        )
-        toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
-            np.asarray,
-            jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
-        )
+        self._active_budgets = [it.budget for it in items]
+        try:
+            toks, lps, hit_eos, count, row_iters, tt, tl = loop(
+                self.params, prefix, prompt_bufs, prompt_lens, first_logits,
+                req_keys, eos_arr, self._bias_array(logit_bias), stop_arr,
+            )
+            toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
+                np.asarray,
+                jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
+            )
+        finally:
+            self._active_budgets = None
         results = self._slice_many_results(
             items, preps, n_per, toks_np, lps_np, eos_np, tt_np, tl_np,
             top_logprobs,
@@ -1528,8 +1653,13 @@ class LocalEngine:
         presence_penalty: float = 0.0,
         logit_bias: Optional[Dict[int, float]] = None,
         stop_sequences: Optional[Sequence[Sequence[int]]] = None,
+        budget: Optional[RequestBudget] = None,
     ) -> GenerationResult:
         config = self.config
+        if budget is not None:
+            # Fail before any device work: a spent budget must not trigger a
+            # prefill (or worse, a compile).
+            budget.check("engine prefill")
         prompt_ids, prompt_len, bucket = self._prep_prompt(prompt_ids)
         stop_arr, use_stops = self._stop_array(stop_sequences)
 
@@ -1570,13 +1700,14 @@ class LocalEngine:
         # verify_step doesn't).
         if self.speculative == "prompt_lookup":
             if not sp_resident:
-                return self._generate_speculative(
+                res = self._generate_speculative(
                     prompt_ids, prompt_len, bucket, n, n_padded, max_new_tokens,
                     temperature, top_p, top_k, seed, eos_arr,
                     constraint, top_logprobs, frequency_penalty,
                     presence_penalty, logit_bias,
-                    stop_arr=stop_arr, use_stops=use_stops,
+                    stop_arr=stop_arr, use_stops=use_stops, budget=budget,
                 )
+                return self._apply_decode_faults(res, budget)
             # Explicit sentinel so operators can tell a served-by-normal-loop
             # request from zero draft acceptance (ADVICE r2).
             spec_stats = {"mode": "sp_decode_fallback"}
@@ -1595,22 +1726,30 @@ class LocalEngine:
             use_logit_bias=logit_bias is not None,
             use_stops=use_stops,
             sp_prefix=sp_resident,
+            use_cancel=budget is not None,
         )
-        toks, lps, done, tt, tl = loop(
-            self.params,
-            prefix,
-            jnp.array([prompt_len], jnp.int32),
-            first_logits,
-            req_keys,
-            eos_arr,
-            self._bias_array(logit_bias),
-            stop_arr,
-        )
+        self._active_budgets = [budget]
+        try:
+            toks, lps, done, tt, tl = loop(
+                self.params,
+                prefix,
+                jnp.array([prompt_len], jnp.int32),
+                first_logits,
+                req_keys,
+                eos_arr,
+                self._bias_array(logit_bias),
+                stop_arr,
+            )
 
-        # ONE host transfer for all outputs: on relayed/remote device platforms
-        # every device_get pays a full round trip (~74 ms through the axon
-        # relay), so fetching the buffers separately would multiply it.
-        toks_np, lps_np, done_np, tt_np, tl_np = jax.device_get((toks, lps, done, tt, tl))
+            # ONE host transfer for all outputs: on relayed/remote device
+            # platforms every device_get pays a full round trip (~74 ms through
+            # the axon relay), so fetching the buffers separately would
+            # multiply it.
+            toks_np, lps_np, done_np, tt_np, tl_np = jax.device_get(
+                (toks, lps, done, tt, tl)
+            )
+        finally:
+            self._active_budgets = None
         toks_np = np.asarray(toks_np)[:n]
         lps_np = np.asarray(lps_np)[:n]
         done_np = np.asarray(done_np)[:n]
@@ -1619,7 +1758,7 @@ class LocalEngine:
         # A sample that emitted pad_id as a real token would undercount; the
         # byte tokenizer never does (pad is a reserved id) and HF pads map to eos.
         finish = ["stop" if d else "length" for d in done_np]
-        return GenerationResult(
+        result = GenerationResult(
             tokens=toks_np,
             logprobs=lps_np,
             lengths=lengths,
@@ -1629,6 +1768,7 @@ class LocalEngine:
             top_logprobs=np.asarray(tl_np)[:n] if top_logprobs else None,
             spec_stats=spec_stats,
         )
+        return self._apply_decode_faults(result, budget)
 
     def generate_many(
         self,
@@ -1656,29 +1796,40 @@ class LocalEngine:
         request axis, and every row group attends to its own prefix — prompt
         KV still stored once per request. Per-request seeds keep their solo
         sampling streams.
+
+        Partial failure: a member whose budget aborts mid-decode (or that an
+        injected fault kills outright) yields an EXCEPTION instance in the
+        returned list instead of a GenerationResult — the scheduler delivers
+        it to just that member's caller; the rest of the batch is unaffected.
         """
         if not items:
             return []
         if len(items) == 1:
             it = items[0]
-            return [
-                self.generate(
-                    it.prompt_ids,
-                    n=it.n,
-                    max_new_tokens=max_new_tokens,
-                    temperature=temperature,
-                    top_p=top_p,
-                    top_k=top_k,
-                    seed=it.seed,
-                    eos_ids=eos_ids,
-                    constraint=constraint,
-                    top_logprobs=top_logprobs,
-                    frequency_penalty=frequency_penalty,
-                    presence_penalty=presence_penalty,
-                    logit_bias=logit_bias,
-                    stop_sequences=stop_sequences,
-                )
-            ]
+            try:
+                return [
+                    self.generate(
+                        it.prompt_ids,
+                        n=it.n,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature,
+                        top_p=top_p,
+                        top_k=top_k,
+                        seed=it.seed,
+                        eos_ids=eos_ids,
+                        constraint=constraint,
+                        top_logprobs=top_logprobs,
+                        frequency_penalty=frequency_penalty,
+                        presence_penalty=presence_penalty,
+                        logit_bias=logit_bias,
+                        stop_sequences=stop_sequences,
+                        budget=it.budget,
+                    )
+                ]
+            except Exception as e:
+                # Same contract as the coalesced path: member failures are
+                # list elements, not batch poison.
+                return [e]
 
         config = self.config
         eos = list(eos_ids or [config.eos_token_id])[:MAX_EOS_IDS]
@@ -1753,37 +1904,60 @@ class LocalEngine:
         # prompt-copying workloads prompt-lookup accelerates. Same semantics
         # as the normal coalesced loop (differential-tested); stats per
         # request on each GenerationResult.
+        use_cancel = any(it.budget is not None for it in items)
         if self.speculative == "prompt_lookup":
             prompt_bufs = np.full((r_pad, bucket_max), config.pad_token_id, np.int32)
             for j, (ids_j, plen_j, _) in enumerate(preps):
                 prompt_bufs[j, :plen_j] = ids_j
             if extra:
                 prompt_bufs[len(items):] = prompt_bufs[len(items) - 1]
-            return self._finish_many_speculative(
+            results = self._finish_many_speculative(
                 items, preps, n_per, max_new_tokens, temperature, top_p, top_k,
                 constraint, top_logprobs, frequency_penalty, presence_penalty,
                 logit_bias, use_stops, stop_arr, eos_arr, r_pad, bucket_max,
                 prefix, jnp.asarray(prompt_bufs), prompt_lens, first_logits,
-                req_keys,
+                req_keys, use_cancel=use_cancel,
             )
+            return self._finalize_many(items, results)
 
         loop = self._get_decode_loop(
             r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
             use_logit_bias=logit_bias is not None,
             use_stops=use_stops,
+            use_cancel=use_cancel,
         )
-        toks, lps, done, tt, tl = loop(
-            self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr,
-            self._bias_array(logit_bias), stop_arr,
-        )
-        toks_np, lps_np, done_np, tt_np, tl_np = map(
-            np.asarray, jax.device_get((toks, lps, done, tt, tl))
-        )
-        return self._slice_many_results(
+        self._active_budgets = [it.budget for it in items]
+        try:
+            toks, lps, done, tt, tl = loop(
+                self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr,
+                self._bias_array(logit_bias), stop_arr,
+            )
+            toks_np, lps_np, done_np, tt_np, tl_np = map(
+                np.asarray, jax.device_get((toks, lps, done, tt, tl))
+            )
+        finally:
+            self._active_budgets = None
+        results = self._slice_many_results(
             items, preps, n_per, toks_np, lps_np, done_np, tt_np, tl_np,
             top_logprobs, spec_stats_fn=lambda lo, n_j: {},
         )
+        return self._finalize_many(items, results)
+
+    def _finalize_many(
+        self, items: Sequence[GenRequestSpec], results: List[GenerationResult]
+    ) -> List[Any]:
+        """Per-member fault surfacing for a coalesced batch: each member gets
+        its own _apply_decode_faults pass; a raised lifecycle/injected error
+        replaces that member's result (the scheduler set_exceptions it to just
+        that caller)."""
+        out: List[Any] = []
+        for it, res in zip(items, results):
+            try:
+                out.append(self._apply_decode_faults(res, it.budget))
+            except Exception as e:
+                out.append(e)
+        return out
 
     # -- embeddings (similarity side-channel) -----------------------------
     def _get_embed(self, batch: int, bucket: int):

@@ -36,6 +36,10 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..reliability import failpoints as _failpoints
+from ..reliability.deadline import RequestBudget
+from ..utils.observability import FAILURE_EVENTS
+
 logger = logging.getLogger(__name__)
 
 
@@ -44,7 +48,16 @@ def _next_pow2(n: int) -> int:
 
 
 class _Item:
-    __slots__ = ("future", "fn", "batch_key", "payload", "batch_fn", "weight", "window")
+    __slots__ = (
+        "future",
+        "fn",
+        "batch_key",
+        "payload",
+        "batch_fn",
+        "weight",
+        "window",
+        "budget",
+    )
 
     def __init__(
         self,
@@ -55,6 +68,7 @@ class _Item:
         batch_fn=None,
         weight=1,
         window=None,
+        budget=None,
     ):
         self.future = future
         self.fn = fn
@@ -63,6 +77,7 @@ class _Item:
         self.batch_fn = batch_fn
         self.weight = weight
         self.window = window
+        self.budget = budget
 
 
 class EngineScheduler:
@@ -90,6 +105,7 @@ class EngineScheduler:
         self._errors = 0
         self._batches = 0
         self._coalesced = 0
+        self._shed = 0
         self.max_batch = max_batch
         self.max_rows = max_rows
         self.batch_window = batch_window
@@ -115,6 +131,11 @@ class EngineScheduler:
             group = [head]
             max_w = head.weight
             window = self.batch_window if head.window is None else head.window
+            # The admission window must never outlive the tightest deadline in
+            # the group: a member with 3 ms of budget left cannot afford a 5 ms
+            # coalescing wait.
+            if head.budget is not None:
+                window = min(window, max(0.0, head.budget.remaining()))
             deadline = time.monotonic() + window
             while len(group) < self.max_batch:
                 if self._items:
@@ -134,6 +155,8 @@ class EngineScheduler:
                     self._items.popleft()
                     max_w = max(max_w, nxt.weight)
                     group.append(nxt)
+                    if nxt.budget is not None:
+                        deadline = min(deadline, nxt.budget.deadline.at)
                     continue
                 if _next_pow2(len(group) + 1) * max_w > self.max_rows:
                     break  # even a weight-1 arrival couldn't be admitted
@@ -143,12 +166,33 @@ class EngineScheduler:
                 self._cv.wait(remaining)
             return group
 
+    def _shed_spent(self, items: List[_Item]) -> List[_Item]:
+        """Drop items whose budget expired or was cancelled while queued:
+        their futures get the typed lifecycle error and they never reach the
+        device. Shedding at dequeue (not just submit) matters because a request
+        can expire while waiting behind a long decode."""
+        live: List[_Item] = []
+        shed = 0
+        for it in items:
+            if it.budget is not None and it.budget.should_abort():
+                shed += 1
+                if not it.future.done():
+                    it.future.set_exception(it.budget.error("scheduler queue"))
+                continue
+            live.append(it)
+        if shed:
+            with self._cv:
+                self._shed += shed
+            FAILURE_EVENTS.record("scheduler.shed", shed)
+        return live
+
     def _run(self) -> None:
         while True:
             group = self._next_group()
             if group is None:
                 return
             live = [it for it in group if it.future.set_running_or_notify_cancel()]
+            live = self._shed_spent(live)
             if not live:
                 continue
             try:
@@ -161,8 +205,20 @@ class EngineScheduler:
                             f"batch runner returned {len(results)} results "
                             f"for {len(live)} requests"
                         )
+                    # A runner may fail individual members of a coalesced batch
+                    # (deadline hit mid-decode, injected sample kill) without
+                    # poisoning the whole group: exception instances in the
+                    # results list are delivered to just that member's caller.
+                    n_failed = 0
                     for it, res in zip(live, results):
-                        it.future.set_result(res)
+                        if isinstance(res, BaseException):
+                            n_failed += 1
+                            it.future.set_exception(res)
+                        else:
+                            it.future.set_result(res)
+                    if n_failed:
+                        with self._cv:
+                            self._errors += n_failed
                 with self._cv:
                     self._served += len(live)
                     if live[0].batch_key is not None:
@@ -181,9 +237,26 @@ class EngineScheduler:
             self._items.append(item)
             self._cv.notify()
 
-    def submit(self, fn: Callable[[], Any]) -> Future:
+    def _admit(self, future: Future, budget: Optional[RequestBudget]) -> bool:
+        """Admission control: work arriving with a spent budget is rejected
+        immediately (the future gets the typed error) instead of occupying
+        queue space it can never use. Also hosts the ``scheduler.admit``
+        failpoint. Returns False when the item was rejected."""
+        _failpoints.fire("scheduler.admit")
+        if budget is not None and budget.should_abort():
+            with self._cv:
+                self._shed += 1
+            FAILURE_EVENTS.record("scheduler.shed")
+            future.set_exception(budget.error("scheduler admission"))
+            return False
+        return True
+
+    def submit(
+        self, fn: Callable[[], Any], budget: Optional[RequestBudget] = None
+    ) -> Future:
         future: Future = Future()
-        self._put(_Item(future, fn=fn))
+        if self._admit(future, budget):
+            self._put(_Item(future, fn=fn, budget=budget))
         return future
 
     def submit_batched(
@@ -193,6 +266,7 @@ class EngineScheduler:
         batch_fn: Callable[[List[Any]], List[Any]],
         weight: int = 1,
         window: Optional[float] = None,
+        budget: Optional[RequestBudget] = None,
     ) -> Future:
         """Enqueue ``payload`` for batched service. Items whose ``batch_key``
         matches the queue head's coalesce into ONE ``batch_fn(payloads)`` call
@@ -202,27 +276,35 @@ class EngineScheduler:
         sample count n) for the ``max_rows`` admission bound. ``window``
         overrides the scheduler's admission window for a group this item
         heads — pass 0.0 for cheap work (e.g. embedding forwards) where the
-        default 5 ms would be a large relative latency cost."""
+        default 5 ms would be a large relative latency cost. ``budget``
+        attaches the request's lifecycle budget: spent budgets are rejected at
+        admission, shed at dequeue, and bound the coalescing window."""
         future: Future = Future()
-        self._put(
-            _Item(
-                future,
-                batch_key=batch_key,
-                payload=payload,
-                batch_fn=batch_fn,
-                weight=weight,
-                window=window,
+        if self._admit(future, budget):
+            self._put(
+                _Item(
+                    future,
+                    batch_key=batch_key,
+                    payload=payload,
+                    batch_fn=batch_fn,
+                    weight=weight,
+                    window=window,
+                    budget=budget,
+                )
             )
-        )
         return future
 
-    def call(self, fn: Callable[[], Any]) -> Any:
+    def call(
+        self, fn: Callable[[], Any], budget: Optional[RequestBudget] = None
+    ) -> Any:
         """Synchronous convenience: submit and wait. Re-entrant from the
         worker thread itself (runs inline — prevents self-deadlock when device
         work triggers more device work, e.g. llm-consensus inside a request)."""
         if threading.current_thread() is self._worker:
+            if budget is not None:
+                budget.check("scheduler admission")
             return fn()
-        return self.submit(fn).result()
+        return self.submit(fn, budget=budget).result()
 
     def call_batched(
         self,
@@ -231,12 +313,20 @@ class EngineScheduler:
         batch_fn: Callable[[List[Any]], List[Any]],
         weight: int = 1,
         window: Optional[float] = None,
+        budget: Optional[RequestBudget] = None,
     ) -> Any:
-        """Synchronous batched submit-and-wait (re-entrant like ``call``)."""
+        """Synchronous batched submit-and-wait (re-entrant like ``call``).
+        Per-member failures surface here: if the runner returned an exception
+        instance for this payload, it is raised to the caller."""
         if threading.current_thread() is self._worker:
-            return batch_fn([payload])[0]
+            if budget is not None:
+                budget.check("scheduler admission")
+            res = batch_fn([payload])[0]
+            if isinstance(res, BaseException):
+                raise res
+            return res
         return self.submit_batched(
-            batch_key, payload, batch_fn, weight=weight, window=window
+            batch_key, payload, batch_fn, weight=weight, window=window, budget=budget
         ).result()
 
     @property
@@ -248,6 +338,7 @@ class EngineScheduler:
                 "errors": self._errors,
                 "batches": self._batches,
                 "coalesced": self._coalesced,
+                "shed": self._shed,
             }
 
     def shutdown(self) -> None:

@@ -1,0 +1,24 @@
+"""Request-lifecycle reliability: deadlines, cancellation, retry/backoff,
+circuit breaking, and deterministic failpoint injection.
+
+The reference SDK gets all of this from the hosted OpenAI client (``timeout=``
+wire contract, SDK retries, server-side shedding); a local TPU engine owns the
+whole lifecycle, so this package provides the equivalents and the seams to
+test them without real faults.
+"""
+
+from . import failpoints
+from .deadline import Deadline, RequestBudget
+from .failpoints import FailSpec, failpoints as failpoint_scope
+from .retry import CircuitBreaker, RetryPolicy, is_retryable
+
+__all__ = [
+    "CircuitBreaker",
+    "Deadline",
+    "FailSpec",
+    "RequestBudget",
+    "RetryPolicy",
+    "failpoint_scope",
+    "failpoints",
+    "is_retryable",
+]

@@ -1,0 +1,159 @@
+"""Deterministic failpoint registry (fail-rs / Jepsen-style fault injection).
+
+Every hardened failure path in the serving stack must be exercisable on CPU
+without real faults. Sites are named strings compiled into the hot path as a
+single dict lookup against an almost-always-empty registry (no-op in
+production); activation is per-test via the ``failpoints`` context manager or
+process-wide via ``KLLMS_FAILPOINTS``.
+
+Injection sites wired in this package:
+
+- ``scheduler.admit``    — evaluated at submit time (admission control)
+- ``engine.decode``      — evaluated per request around the decode loop;
+                           ``kill_samples`` marks a seeded subset of the n
+                           samples as lost mid-decode
+- ``backend.dispatch``   — evaluated per dispatch attempt (retry/circuit path)
+- ``consensus.consolidate`` — evaluated at consolidation entry
+
+Actions (``FailSpec.action``):
+
+- ``"raise"``        — raise ``error_factory()`` (default RuntimeError)
+- ``"sleep"``        — block ``delay`` seconds (deadline-expiry simulation)
+- ``"kill_samples"`` — no-op at the site itself; the engine reads ``kill`` and
+                       ``seed`` and marks that many samples failed
+
+``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
+many evaluations the site reverts to no-op — this is how "backend fails twice
+then recovers" retry tests are scripted.
+
+Env syntax (comma-separated):
+    KLLMS_FAILPOINTS="backend.dispatch=raise:2,engine.decode=kill_samples:3:7"
+where the first numeric arg is ``times`` for raise/sleep specs and
+``kill[:seed]`` for kill_samples.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, Optional
+
+import contextlib
+
+logger = logging.getLogger(__name__)
+
+SITES = (
+    "scheduler.admit",
+    "engine.decode",
+    "backend.dispatch",
+    "consensus.consolidate",
+)
+
+
+@dataclass
+class FailSpec:
+    action: str = "raise"  # "raise" | "sleep" | "kill_samples"
+    error_factory: Callable[[], BaseException] = field(
+        default=lambda: RuntimeError("injected failpoint fault")
+    )
+    times: Optional[int] = None  # fire at most N times; None = every time
+    delay: float = 0.0  # for action="sleep"
+    kill: int = 0  # for action="kill_samples": how many samples to mark lost
+    seed: int = 0  # deterministic sample-kill selection
+    _fired: int = field(default=0, init=False, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.action not in ("raise", "sleep", "kill_samples"):
+            raise ValueError(f"unknown failpoint action {self.action!r}")
+
+
+_lock = threading.Lock()
+_registry: Dict[str, FailSpec] = {}
+
+
+def active() -> bool:
+    return bool(_registry)
+
+
+def fire(site: str) -> Optional[FailSpec]:
+    """Evaluate a site. Returns the spec for data-carrying actions
+    (``kill_samples``); performs ``raise``/``sleep`` directly. The common
+    production path is one falsy dict check."""
+    if not _registry:
+        return None
+    with _lock:
+        spec = _registry.get(site)
+        if spec is None:
+            return None
+        if spec.times is not None:
+            if spec._fired >= spec.times:
+                return None
+            spec._fired += 1
+    logger.debug("failpoint %s fired (%s)", site, spec.action)
+    if spec.action == "raise":
+        raise spec.error_factory()
+    if spec.action == "sleep":
+        time.sleep(spec.delay)
+        return None
+    return spec  # kill_samples: the site's owner interprets kill/seed
+
+
+@contextlib.contextmanager
+def failpoints(specs: Dict[str, FailSpec]) -> Iterator[None]:
+    """Activate failpoints for a block; restores the previous registry (so
+    nested scopes and test isolation compose)."""
+    unknown = [s for s in specs if s not in SITES]
+    if unknown:
+        raise ValueError(f"unknown failpoint site(s) {unknown}; known: {list(SITES)}")
+    with _lock:
+        prev = dict(_registry)
+        _registry.update(specs)
+    try:
+        yield
+    finally:
+        with _lock:
+            _registry.clear()
+            _registry.update(prev)
+
+
+def clear() -> None:
+    with _lock:
+        _registry.clear()
+
+
+def configure_from_env(env: Optional[str] = None) -> None:
+    """Parse ``KLLMS_FAILPOINTS`` into the registry (process-wide activation
+    for soak/chaos runs). Unknown sites fail loudly — a typo'd site name that
+    silently never fires is worse than no injection."""
+    raw = env if env is not None else os.getenv("KLLMS_FAILPOINTS", "")
+    if not raw:
+        return
+    specs: Dict[str, FailSpec] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, rhs = part.partition("=")
+        action, *args = rhs.split(":")
+        if action == "kill_samples":
+            kill = int(args[0]) if args else 1
+            seed = int(args[1]) if len(args) > 1 else 0
+            specs[site] = FailSpec(action="kill_samples", kill=kill, seed=seed)
+        elif action == "sleep":
+            delay = float(args[0]) if args else 0.1
+            times = int(args[1]) if len(args) > 1 else None
+            specs[site] = FailSpec(action="sleep", delay=delay, times=times)
+        else:
+            times = int(args[0]) if args else None
+            specs[site] = FailSpec(action="raise", times=times)
+    unknown = [s for s in specs if s not in SITES]
+    if unknown:
+        raise ValueError(f"KLLMS_FAILPOINTS names unknown site(s) {unknown}")
+    with _lock:
+        _registry.update(specs)
+
+
+configure_from_env()

@@ -22,6 +22,7 @@ from ..consensus.consolidation import (
 )
 from ..consensus.settings import ConsensusSettings
 from ..consensus.similarity import SimilarityScorer
+from ..reliability.deadline import RequestBudget
 from ..types import KLLMsChatCompletion, KLLMsParsedChatCompletion
 from ..utils.observability import Trace
 
@@ -75,9 +76,21 @@ def _build_request(
     seed: Optional[int],
     response_format: Optional[Any],
     kwargs: dict,
+    timeout: Optional[float] = None,
 ) -> ChatRequest:
     kwargs = dict(kwargs)
     kwargs.pop("stream", None)  # streaming unsupported, like the reference (:36)
+    # Lifecycle budget: ``timeout=`` (seconds, the OpenAI per-call wire
+    # contract) builds one; advanced callers pass ``budget=`` directly to hold
+    # the cancel handle. Deadline.from_timeout 400s a negative timeout here,
+    # with the other parameter errors.
+    budget = kwargs.pop("budget", None)
+    if budget is not None and not isinstance(budget, RequestBudget):
+        raise ValueError(
+            f"budget must be a RequestBudget, got {type(budget).__name__}"
+        )
+    if budget is None and timeout is not None:
+        budget = RequestBudget.from_timeout(timeout)
     logprobs = kwargs.pop("logprobs", None)
     top_logprobs = kwargs.pop("top_logprobs", None)
     if top_logprobs is not None and not 0 <= int(top_logprobs) <= 20:
@@ -126,6 +139,7 @@ def _build_request(
         stop=stop,
         seed=seed,
         response_format=response_format,
+        budget=budget,
         extra=kwargs,
     )
 
@@ -158,22 +172,27 @@ class Completions:
         seed: Optional[int] = None,
         response_format: Optional[Any] = None,
         consensus_settings: Optional[ConsensusSettings] = None,
+        timeout: Optional[float] = None,
         **kwargs: Any,
     ) -> KLLMsChatCompletion:
         settings = consensus_settings or ConsensusSettings()
+        if timeout is None:
+            timeout = getattr(self._wrapper, "default_timeout", None)
         request = _build_request(
             messages, model or self._wrapper.default_model, n, temperature, max_tokens,
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
+            timeout=timeout,
         )
         trace = Trace()
         with trace.phase("sample"):
-            completion = self._wrapper.backend.chat_completion(request)
+            completion = self._wrapper.backend.dispatch_chat_completion(request)
         with trace.phase("consolidate"):
             result = consolidate_chat_completions(
                 completion,
                 self._scorer(settings),
                 consensus_settings=settings,
                 llm_consensus_fn=self._wrapper.backend.llm_consensus,
+                budget=request.budget,
             )
         return _attach_trace(result, trace, self._wrapper.backend)
 
@@ -192,16 +211,20 @@ class Completions:
         stop: Optional[Union[str, List[str]]] = None,
         seed: Optional[int] = None,
         consensus_settings: Optional[ConsensusSettings] = None,
+        timeout: Optional[float] = None,
         **kwargs: Any,
     ) -> KLLMsParsedChatCompletion:
         settings = consensus_settings or ConsensusSettings()
+        if timeout is None:
+            timeout = getattr(self._wrapper, "default_timeout", None)
         request = _build_request(
             messages, model or self._wrapper.default_model, n, temperature, max_tokens,
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
+            timeout=timeout,
         )
         trace = Trace()
         with trace.phase("sample"):
-            completion = self._wrapper.backend.chat_completion(request)
+            completion = self._wrapper.backend.dispatch_chat_completion(request)
         with trace.phase("consolidate"):
             result = consolidate_parsed_chat_completions(
                 completion,
@@ -209,6 +232,7 @@ class Completions:
                 consensus_settings=settings,
                 response_format=response_format,
                 llm_consensus_fn=self._wrapper.backend.llm_consensus,
+                budget=request.budget,
             )
         return _attach_trace(result, trace, self._wrapper.backend)
 

@@ -40,7 +40,20 @@ except ImportError:  # vendored fallback
 from .completions import KLLMsChatCompletion
 from .parsed import KLLMsParsedChatCompletion
 
+# Typed request-lifecycle errors are always ours (the openai package's
+# exceptions wrap httpx responses we don't have), vendored in wire.py.
+from .wire import (
+    BackendUnavailableError,
+    KLLMsError,
+    RequestCancelledError,
+    RequestTimeoutError,
+)
+
 __all__ = [
+    "BackendUnavailableError",
+    "KLLMsError",
+    "RequestCancelledError",
+    "RequestTimeoutError",
     "ChatCompletion",
     "ChatCompletionMessage",
     "Choice",

@@ -32,3 +32,13 @@ class KLLMsChatCompletion(_chat_completion_base()):
             "consensus. Follows the same structure as the extraction object."
         ),
     )
+
+    degraded: Optional[Dict[str, Any]] = Field(
+        default=None,
+        description=(
+            "Partial-failure marker: present when fewer than the requested n "
+            "samples survived (timeout, decode fault, failpoint). Carries "
+            "requested/survived counts, the survival fraction the likelihoods "
+            "were scaled by, and per-sample error records."
+        ),
+    )

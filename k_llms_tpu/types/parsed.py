@@ -31,3 +31,11 @@ class KLLMsParsedChatCompletion(_parsed_chat_completion_base()):
             "consensus. Follows the same structure as the extraction object."
         ),
     )
+
+    degraded: Optional[Dict[str, Any]] = Field(
+        default=None,
+        description=(
+            "Partial-failure marker: present when fewer than the requested n "
+            "samples survived; see KLLMsChatCompletion.degraded."
+        ),
+    )

@@ -126,3 +126,63 @@ class ParsedChatCompletion(ChatCompletion, Generic[ContentType]):
 
 # Request-side aliases (the reference types these loosely; we accept plain dicts)
 ChatCompletionMessageParam = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Typed request-lifecycle errors (OpenAI error shapes)
+# ---------------------------------------------------------------------------
+# The reference leans on the ``openai`` client's exception hierarchy
+# (APITimeoutError, APIConnectionError, InternalServerError); a local engine
+# must supply the same reliability contract itself. These carry the OpenAI
+# wire error payload ({"error": {"message", "type", "code"}}) so a serving
+# frontend can return them byte-compatibly.
+
+
+class KLLMsError(Exception):
+    """Base typed error; subclasses pin ``type``/``code``/``status_code`` to
+    the OpenAI wire values for the failure class they represent."""
+
+    type: str = "api_error"
+    code: Optional[str] = None
+    status_code: int = 500
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+    def as_wire(self) -> Dict[str, Any]:
+        """The OpenAI HTTP error body for this exception."""
+        return {
+            "error": {
+                "message": self.message,
+                "type": self.type,
+                "code": self.code,
+                "param": None,
+            }
+        }
+
+
+class RequestTimeoutError(KLLMsError):
+    """Deadline exceeded — queued past its deadline, or cancelled at token
+    granularity mid-decode (openai.APITimeoutError's wire shape)."""
+
+    type = "timeout"
+    code = "request_timeout"
+    status_code = 408
+
+
+class RequestCancelledError(KLLMsError):
+    """Caller cancelled the request via its :class:`RequestBudget`."""
+
+    type = "cancelled"
+    code = "request_cancelled"
+    status_code = 499  # nginx's client-closed-request; OpenAI has no cancel code
+
+
+class BackendUnavailableError(KLLMsError):
+    """The model engine cannot serve: circuit open, retries exhausted, or all
+    samples lost (openai.InternalServerError / APIConnectionError class)."""
+
+    type = "server_error"
+    code = "backend_unavailable"
+    status_code = 503

@@ -12,6 +12,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -58,6 +59,39 @@ def device_profiler(log_dir: Optional[str] = None) -> Iterator[None]:
         return
     with jax.profiler.trace(log_dir):
         yield
+
+
+class EventCounters:
+    """Thread-safe named counters for failure-path events (retries, circuit
+    trips, deadline sheds, decode aborts, failpoint kills). Cheap enough to
+    record from the scheduler worker and dispatch paths; snapshot from tests
+    or a stats endpoint."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def record(self, event: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[event] = self._counts.get(event, 0) + n
+
+    def get(self, event: str) -> int:
+        with self._lock:
+            return self._counts.get(event, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+#: Process-wide failure-event counters shared by the reliability layer
+#: (retry attempts, circuit transitions), the scheduler (deadline sheds,
+#: cancellations), and the engine (decode aborts, killed samples).
+FAILURE_EVENTS = EventCounters()
 
 
 def _walk_confidences(node: Any, out: List[float]) -> None:

@@ -60,6 +60,20 @@ PARITY_VECTORS: list[tuple[str, str]] = [
     ("汉字", "Han Zi "),
     ("漢字", "Han Zi "),
     ("日本語", "Ri Ben Yu "),
+    # Polyphonic hanzi — characters with several Mandarin readings where
+    # unidecode's Unihan tables pin ONE canonical choice; these guard the
+    # hand-pinned frequency table against picking a different (valid but
+    # non-parity) reading.  le/liao → "Liao ", zhe/zhao/zhuo → "Zhao ",
+    # shei/shui → "Shui ", dou/du → "Du ", zhong/chong → "Zhong ",
+    # xing/hang → "Xing ".
+    ("了", "Liao "),
+    ("着", "Zhao "),
+    ("谁", "Shui "),
+    ("都", "Du "),
+    ("重", "Zhong "),
+    ("行", "Xing "),
+    ("了不起", "Liao Bu Qi "),
+    ("重行", "Zhong Xing "),
     # Kana (lowercase romaji, no separators; unidecode's famous quirks kept:
     # は stays "ha" even as a particle, small っ is "tsu", ー is "-")
     ("こんにちは", "konnichiha"),
@@ -70,12 +84,23 @@ PARITY_VECTORS: list[tuple[str, str]] = [
     # Hangul (algorithmic jamo decomposition, RR letter values)
     ("서울", "seoul"),
     ("안녕", "annyeong"),
+    # NFD form of 서울 — conjoining jamo U+1109 U+1165 U+110B U+116E U+11AF
+    # (macOS-filename / NFD-pipeline normalization).  Real unidecode romanizes
+    # the x011 jamo block directly to the same letters; our transliterator
+    # NFC-composes jamo runs back into syllables first, so both agree.
+    ("\u1109\u1165\u110b\u116e\u11af", "seoul"),
 ]
 
 # (input, real unidecode output, our transliterate output = per-codepoint
 # tokens).  Long-tail ideographs outside the frequency table: real unidecode
 # carries full Unihan tables and still romanizes these; we keep them distinct
 # via u<hex> tokens instead.
+#
+# Provenance: the "real" outputs below are hand-encoded from unidecode 1.3.8's
+# published data tables (x09e.py / x07f.py), NOT verified against an installed
+# wheel in this image.  Tests only assert got != real (documented divergence),
+# so a wrong hand-encoded value here cannot fail a test — if you bump the
+# pinned version or gain access to the wheel, re-verify these two entries.
 DIVERGENT_VECTORS: list[tuple[str, str, str]] = [
     (inp, real, "".join(f"u{ord(c):04x}" for c in inp))
     for inp, real in [

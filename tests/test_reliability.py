@@ -1,0 +1,581 @@
+"""Request-lifecycle hardening: deadlines, cancellation, retry/circuit,
+failpoint injection, and partial-failure consensus — all CPU-only.
+
+The reference SDK inherits timeout/retry machinery from the OpenAI client
+(PAPER.md §0); this suite pins the locally-built replacement end to end:
+unit behavior of the reliability primitives, typed-error wire shapes, and the
+ISSUE acceptance scenarios (seeded mid-decode sample kills degrade to a
+survivor consensus; zero survivors / pre-admission expiry raise typed errors
+within the deadline plus one scheduler window).
+"""
+
+import math
+import threading
+import time
+
+import pytest
+
+from k_llms_tpu import KLLMs
+from k_llms_tpu.reliability import failpoints as fp
+from k_llms_tpu.reliability.deadline import Deadline, RequestBudget
+from k_llms_tpu.reliability.failpoints import FailSpec
+from k_llms_tpu.reliability.retry import CircuitBreaker, RetryPolicy, is_retryable
+from k_llms_tpu.types.wire import (
+    BackendUnavailableError,
+    KLLMsError,
+    RequestCancelledError,
+    RequestTimeoutError,
+)
+from k_llms_tpu.utils.observability import EventCounters
+
+
+# -- Deadline / RequestBudget ---------------------------------------------
+
+
+def test_deadline_infinite_by_default():
+    d = Deadline()
+    assert not d.finite
+    assert d.remaining() == math.inf
+    assert not d.expired()
+    assert not Deadline.from_timeout(None).finite
+
+
+def test_deadline_from_timeout_counts_down():
+    d = Deadline.from_timeout(30.0)
+    assert d.finite
+    assert 29.0 < d.remaining() <= 30.0
+    assert not d.expired()
+    assert Deadline.from_timeout(0.0).expired()
+
+
+def test_deadline_negative_timeout_rejected():
+    with pytest.raises(ValueError, match="timeout must be >= 0"):
+        Deadline.from_timeout(-1.0)
+
+
+def test_budget_cancel_token():
+    b = RequestBudget.from_timeout(None)
+    assert not b.should_abort()
+    b.check("anywhere")  # no-op while healthy
+    b.cancel()
+    assert b.cancelled and b.should_abort()
+    with pytest.raises(RequestCancelledError, match="at stage-x"):
+        b.check("stage-x")
+
+
+def test_budget_expiry_raises_timeout():
+    b = RequestBudget.from_timeout(0.0)
+    assert b.should_abort()
+    with pytest.raises(RequestTimeoutError, match="deadline exceeded"):
+        b.check("queue")
+
+
+def test_budget_cancel_verdict_wins_over_expiry():
+    """Cancel is the caller's explicit signal; expiry is incidental."""
+    b = RequestBudget.from_timeout(0.0)
+    b.cancel()
+    assert isinstance(b.error(), RequestCancelledError)
+
+
+# -- typed error wire shapes ----------------------------------------------
+
+
+def test_error_wire_shapes_match_openai_contract():
+    cases = [
+        (RequestTimeoutError("t"), "timeout", "request_timeout", 408),
+        (RequestCancelledError("c"), "cancelled", "request_cancelled", 499),
+        (BackendUnavailableError("b"), "server_error", "backend_unavailable", 503),
+    ]
+    for err, etype, code, status in cases:
+        assert isinstance(err, KLLMsError)
+        assert err.status_code == status
+        wire = err.as_wire()
+        assert wire["error"]["type"] == etype
+        assert wire["error"]["code"] == code
+        assert wire["error"]["message"]
+
+
+# -- RetryPolicy ----------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    policy = RetryPolicy(max_attempts=3, base_delay=0.01, seed=7)
+    sleeps = []
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert policy.call(flaky, sleep=sleeps.append) == "ok"
+    assert len(attempts) == 3
+    assert len(sleeps) == 2
+    assert all(s >= 0 for s in sleeps)
+
+
+def test_retry_exhaustion_raises_last_error():
+    policy = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=False)
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        policy.call(always_down, sleep=lambda _s: None)
+    assert len(calls) == 2
+
+
+def test_retry_skips_non_retryable():
+    policy = RetryPolicy(max_attempts=5)
+    calls = []
+
+    def param_bug():
+        calls.append(1)
+        raise ValueError("caller bug")
+
+    with pytest.raises(ValueError):
+        policy.call(param_bug)
+    assert len(calls) == 1  # parameter errors fail identically every attempt
+    assert not is_retryable(ValueError("x"))
+    assert not is_retryable(RequestTimeoutError("final verdict"))
+    assert is_retryable(OSError("transient"))
+
+
+def test_retry_deterministic_schedule_with_seed():
+    a = RetryPolicy(max_attempts=4, base_delay=0.05, seed=123)
+    b = RetryPolicy(max_attempts=4, base_delay=0.05, seed=123)
+    assert [a.delay_for(k) for k in (1, 2, 3)] == [b.delay_for(k) for k in (1, 2, 3)]
+    nj = RetryPolicy(base_delay=0.05, max_delay=2.0, jitter=False)
+    assert [nj.delay_for(k) for k in (1, 2, 3)] == [0.05, 0.1, 0.2]
+    assert nj.delay_for(20) == 2.0  # capped
+
+
+def test_retry_respects_spent_budget():
+    policy = RetryPolicy(max_attempts=5, base_delay=0.0)
+    budget = RequestBudget.from_timeout(0.0)
+    with pytest.raises(RequestTimeoutError):
+        policy.call(lambda: "never", budget=budget)
+
+
+def test_retry_sleep_bounded_by_remaining_budget():
+    policy = RetryPolicy(max_attempts=3, base_delay=10.0, jitter=False)
+    budget = RequestBudget.from_timeout(0.2)
+    sleeps = []
+
+    def flaky():
+        if not sleeps:
+            raise OSError("once")
+        return "ok"
+
+    assert policy.call(flaky, budget=budget, sleep=sleeps.append) == "ok"
+    assert len(sleeps) == 1
+    assert sleeps[0] <= 0.2  # a retry never outlives the deadline
+
+
+# -- CircuitBreaker -------------------------------------------------------
+
+
+def make_breaker(**kw):
+    clock = [0.0]
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("reset_timeout", 10.0)
+    return CircuitBreaker(clock=lambda: clock[0], **kw), clock
+
+
+def test_circuit_opens_after_threshold_and_sheds_fast():
+    br, _clock = make_breaker()
+    for _ in range(3):
+        br.allow()
+        br.record_failure()
+    assert br.state == "open"
+    with pytest.raises(BackendUnavailableError, match="circuit open"):
+        br.allow()
+
+
+def test_circuit_half_open_probe_then_close():
+    br, clock = make_breaker()
+    for _ in range(3):
+        br.record_failure()
+    clock[0] = 10.0  # reset_timeout elapsed: one probe admitted
+    br.allow()
+    assert br.state == "half_open"
+    with pytest.raises(BackendUnavailableError, match="probe in flight"):
+        br.allow()  # concurrent callers shed while the probe runs
+    br.record_success()
+    assert br.state == "closed"
+    br.allow()
+
+
+def test_circuit_half_open_probe_failure_reopens():
+    br, clock = make_breaker()
+    for _ in range(3):
+        br.record_failure()
+    clock[0] = 10.0
+    br.allow()  # probe admitted
+    br.record_failure()
+    assert br.state == "open"
+    clock[0] = 15.0  # opened_at moved to 10.0; not yet due again
+    with pytest.raises(BackendUnavailableError):
+        br.allow()
+
+
+def test_circuit_success_resets_failure_streak():
+    br, _clock = make_breaker()
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # streak broken
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # threshold counts CONSECUTIVE failures
+
+
+# -- failpoints -----------------------------------------------------------
+
+
+def test_failpoint_raise_bounded_by_times():
+    with fp.failpoints({"backend.dispatch": FailSpec(action="raise", times=2)}):
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="injected failpoint"):
+                fp.fire("backend.dispatch")
+        assert fp.fire("backend.dispatch") is None  # reverted to no-op
+        assert fp.fire("engine.decode") is None  # other sites untouched
+    assert not fp.active()
+
+
+def test_failpoint_kill_samples_returns_spec():
+    spec = FailSpec(action="kill_samples", kill=3, seed=9)
+    with fp.failpoints({"engine.decode": spec}):
+        got = fp.fire("engine.decode")
+        assert got is spec and got.kill == 3 and got.seed == 9
+
+
+def test_failpoint_unknown_site_fails_loudly():
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        with fp.failpoints({"scheduler.typo": FailSpec()}):
+            pass  # pragma: no cover
+    with pytest.raises(ValueError, match="unknown failpoint action"):
+        FailSpec(action="explode")
+
+
+def test_failpoint_scopes_nest_and_restore():
+    outer = FailSpec(action="kill_samples", kill=1)
+    inner = FailSpec(action="kill_samples", kill=2)
+    with fp.failpoints({"engine.decode": outer}):
+        with fp.failpoints({"engine.decode": inner}):
+            assert fp.fire("engine.decode").kill == 2
+        assert fp.fire("engine.decode").kill == 1
+    assert fp.fire("engine.decode") is None
+
+
+def test_failpoint_env_parsing():
+    fp.configure_from_env("backend.dispatch=raise:2,engine.decode=kill_samples:3:7")
+    try:
+        assert fp._registry["backend.dispatch"].times == 2
+        spec = fp._registry["engine.decode"]
+        assert spec.action == "kill_samples" and spec.kill == 3 and spec.seed == 7
+    finally:
+        fp.clear()
+    with pytest.raises(ValueError, match="unknown site"):
+        fp.configure_from_env("nonsense.site=raise")
+    fp.clear()
+    fp.configure_from_env("")  # empty env is a no-op
+    assert not fp.active()
+
+
+# -- failure-event counters -----------------------------------------------
+
+
+def test_event_counters():
+    c = EventCounters()
+    assert c.get("x") == 0
+    c.record("x")
+    c.record("x", 2)
+    c.record("y")
+    assert c.get("x") == 3
+    snap = c.snapshot()
+    assert snap == {"x": 3, "y": 1}
+    c.record("x")
+    assert snap["x"] == 3  # snapshot is a copy, not a view
+    c.reset()
+    assert c.snapshot() == {}
+
+
+# -- client plumbing (fake backend: hermetic, no device work) -------------
+
+
+def make_fake_client(contents, **kw):
+    return KLLMs(backend="fake", responses=[contents], **kw)
+
+
+def test_create_rejects_negative_timeout():
+    client = make_fake_client(["a"])
+    with pytest.raises(ValueError, match="timeout must be >= 0"):
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "q"}], model="m", timeout=-1
+        )
+
+
+def test_create_rejects_bad_budget_type():
+    client = make_fake_client(["a"])
+    with pytest.raises(ValueError, match="budget must be a RequestBudget"):
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "q"}], model="m", budget=3.0
+        )
+
+
+def test_expired_timeout_raises_typed_error_fast():
+    client = make_fake_client(["a", "b"])
+    t0 = time.monotonic()
+    with pytest.raises(RequestTimeoutError):
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "q"}], model="m", n=2, timeout=0.0
+        )
+    assert time.monotonic() - t0 < 1.0  # shed, not served
+
+
+def test_client_level_default_timeout_applies():
+    client = make_fake_client(["a"], timeout=0.0)
+    with pytest.raises(RequestTimeoutError):
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "q"}], model="m"
+        )
+    # per-call timeout overrides the client default
+    resp = client.chat.completions.create(
+        messages=[{"role": "user", "content": "q"}], model="m", timeout=30.0
+    )
+    assert resp.choices[0].message.content == "a"
+
+
+def test_pre_cancelled_budget_raises_cancelled():
+    client = make_fake_client(["a"])
+    budget = RequestBudget.from_timeout(None)
+    budget.cancel()
+    with pytest.raises(RequestCancelledError):
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": "q"}], model="m", budget=budget
+        )
+
+
+def test_dispatch_retries_transient_backend_fault():
+    """backend.dispatch raise:2 with max_attempts=3: two injected faults are
+    absorbed by the retry policy and the request still succeeds."""
+    client = make_fake_client(["hello"])
+    client.backend.retry_policy = RetryPolicy(max_attempts=3, base_delay=0.0, seed=1)
+    with fp.failpoints({"backend.dispatch": FailSpec(action="raise", times=2)}):
+        resp = client.chat.completions.create(
+            messages=[{"role": "user", "content": "q"}], model="m"
+        )
+    assert resp.choices[0].message.content == "hello"
+
+
+def test_dispatch_circuit_opens_on_persistent_fault():
+    """A backend that fails every dispatch trips its circuit breaker; the
+    breaker then sheds subsequent calls with the typed unavailable error."""
+    client = make_fake_client(["hello"])
+    client.backend.retry_policy = RetryPolicy(max_attempts=1)
+    breaker = client.backend.circuit_breaker
+    assert breaker is client.backend.circuit_breaker  # lazily cached per backend
+    with fp.failpoints({"backend.dispatch": FailSpec(action="raise")}):
+        for _ in range(breaker.failure_threshold):
+            with pytest.raises(RuntimeError):
+                client.chat.completions.create(
+                    messages=[{"role": "user", "content": "q"}], model="m"
+                )
+        assert breaker.state == "open"
+        with pytest.raises(BackendUnavailableError):
+            client.chat.completions.create(
+                messages=[{"role": "user", "content": "q"}], model="m"
+            )
+    breaker.record_success()  # close it again for other tests
+
+
+# -- acceptance: partial-failure consensus on the real engine -------------
+
+
+@pytest.fixture(scope="module")
+def tpu_client():
+    return KLLMs(backend="tpu", model="tiny", max_new_tokens=16)
+
+
+def test_kill_3_of_8_degrades_to_survivor_consensus(tpu_client):
+    """ISSUE acceptance: a seeded failpoint kills 3 of n=8 samples mid-decode;
+    create() still returns a consensus built from the 5 survivors, with a
+    structured degraded marker and survival-scaled likelihoods."""
+    with fp.failpoints(
+        {"engine.decode": FailSpec(action="kill_samples", kill=3, seed=4)}
+    ):
+        resp = tpu_client.chat.completions.create(
+            messages=[{"role": "user", "content": "report"}],
+            model="tiny",
+            n=8,
+            temperature=0.0,
+            seed=11,
+        )
+    assert len(resp.choices) == 9  # consensus + 8 originals
+    killed = [c for c in resp.choices[1:] if getattr(c, "sample_error", None)]
+    survivors = [c for c in resp.choices[1:] if not getattr(c, "sample_error", None)]
+    assert len(killed) == 3 and len(survivors) == 5
+    assert all(c.message.content == "" for c in killed)
+    assert all(k.sample_error["code"] == "decode_fault" for k in killed)
+    # consensus comes from the survivors (greedy: all five agree)
+    assert resp.choices[0].message.content == survivors[0].message.content
+    assert resp.choices[0].message.content != ""
+    # structured degraded marker
+    assert resp.degraded["requested"] == 8
+    assert resp.degraded["survived"] == 5
+    assert resp.degraded["survival_fraction"] == pytest.approx(5 / 8)
+    assert len(resp.degraded["sample_errors"]) == 3
+    # survival-scaled likelihoods: unanimous survivors would score 1.0; the
+    # loss of 3/8 samples scales that to 0.625
+    assert resp.likelihoods == {"text": pytest.approx(5 / 8)}
+
+
+def test_kill_all_samples_raises_typed_error(tpu_client):
+    """Zero survivors is not a consensus: the typed backend error surfaces."""
+    with fp.failpoints(
+        {"engine.decode": FailSpec(action="kill_samples", kill=8, seed=0)}
+    ):
+        with pytest.raises(BackendUnavailableError, match="all 8 samples failed"):
+            tpu_client.chat.completions.create(
+                messages=[{"role": "user", "content": "report"}],
+                model="tiny",
+                n=8,
+                temperature=0.0,
+                seed=11,
+            )
+
+
+def test_healthy_request_has_no_degraded_marker(tpu_client):
+    resp = tpu_client.chat.completions.create(
+        messages=[{"role": "user", "content": "ok"}], model="tiny", n=3, seed=2
+    )
+    assert resp.degraded is None
+    assert len(resp.choices) == 4
+
+
+def test_deadline_expired_pre_admission_bounded(tpu_client):
+    """ISSUE acceptance: an already-expired deadline raises the typed error
+    within timeout + one scheduler window — never reaching the device."""
+    served_before = tpu_client.backend.scheduler.stats["served"]
+    t0 = time.monotonic()
+    with pytest.raises(RequestTimeoutError):
+        tpu_client.chat.completions.create(
+            messages=[{"role": "user", "content": "late"}],
+            model="tiny",
+            n=8,
+            timeout=0.0,
+        )
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.0 + tpu_client.backend.scheduler.batch_window + 1.0
+    assert tpu_client.backend.scheduler.stats["served"] == served_before
+
+
+def test_mid_decode_cancellation_stops_at_token_granularity(tpu_client):
+    """An in-flight request cancelled from another thread stops between decode
+    steps and surfaces the typed cancellation error."""
+    msgs = [{"role": "user", "content": "long story"}]
+    # Warm the compile caches (with and without the cancel poller) so the
+    # cancel below lands during DECODE, not during XLA compilation.
+    warm = RequestBudget.from_timeout(None)
+    tpu_client.chat.completions.create(
+        messages=msgs, model="tiny", n=2, max_tokens=512, seed=3, budget=warm,
+        stop="\x00",  # unmatchable: forces the full 512-token decode shape
+    )
+    budget = RequestBudget.from_timeout(None)
+    box = {}
+
+    def run():
+        t0 = time.monotonic()
+        try:
+            tpu_client.chat.completions.create(
+                messages=msgs, model="tiny", n=2, max_tokens=512, seed=3,
+                budget=budget, stop="\x00",
+            )
+            box["outcome"] = "completed"
+        except RequestCancelledError:
+            box["outcome"] = "cancelled"
+        except Exception as e:  # pragma: no cover - diagnostic
+            box["outcome"] = repr(e)
+        box["elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.3)  # let decode start (warm path: prefill is milliseconds)
+    budget.cancel()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert box["outcome"] == "cancelled", box
+
+
+def test_timeout_expiring_mid_decode_raises_timeout(tpu_client):
+    """A finite deadline shorter than the decode aborts between steps with the
+    timeout error (same poller as cancellation, different verdict)."""
+    msgs = [{"role": "user", "content": "long story"}]
+    warm = RequestBudget.from_timeout(None)
+    tpu_client.chat.completions.create(
+        messages=msgs, model="tiny", n=2, max_tokens=512, seed=3, budget=warm,
+        stop="\x00",
+    )
+    with pytest.raises(RequestTimeoutError):
+        tpu_client.chat.completions.create(
+            messages=msgs, model="tiny", n=2, max_tokens=512, seed=3,
+            timeout=0.25, stop="\x00",
+        )
+
+
+@pytest.mark.slow
+def test_chaos_soak_mixed_failpoints(tpu_client):
+    """Long-running chaos soak (excluded from the tier-1 budget run via the
+    registered ``slow`` marker): alternate healthy, degraded, dispatch-fault,
+    and shed requests for many rounds and assert the serving stack never
+    wedges — every request either returns a well-formed response or raises a
+    typed lifecycle error, and a healthy request still succeeds at the end."""
+    msgs = [{"role": "user", "content": "soak"}]
+    outcomes = {"ok": 0, "degraded": 0, "typed": 0}
+    for round_ in range(12):
+        mode = round_ % 4
+        try:
+            if mode == 0:
+                resp = tpu_client.chat.completions.create(
+                    messages=msgs, model="tiny", n=4, seed=round_
+                )
+            elif mode == 1:
+                with fp.failpoints(
+                    {"engine.decode": FailSpec(action="kill_samples", kill=2, seed=round_)}
+                ):
+                    resp = tpu_client.chat.completions.create(
+                        messages=msgs, model="tiny", n=4, temperature=0.0, seed=round_
+                    )
+            elif mode == 2:
+                tpu_client.backend.retry_policy = RetryPolicy(
+                    max_attempts=3, base_delay=0.0, seed=round_
+                )
+                with fp.failpoints(
+                    {"backend.dispatch": FailSpec(action="raise", times=1)}
+                ):
+                    resp = tpu_client.chat.completions.create(
+                        messages=msgs, model="tiny", n=4, seed=round_
+                    )
+            else:
+                with pytest.raises(RequestTimeoutError):
+                    tpu_client.chat.completions.create(
+                        messages=msgs, model="tiny", n=4, timeout=0.0
+                    )
+                outcomes["typed"] += 1
+                continue
+        except KLLMsError:
+            outcomes["typed"] += 1
+            continue
+        assert len(resp.choices) == 5
+        if resp.degraded is not None:
+            assert resp.degraded["survived"] == 2
+            outcomes["degraded"] += 1
+        else:
+            outcomes["ok"] += 1
+    assert outcomes["ok"] >= 3 and outcomes["degraded"] >= 3 and outcomes["typed"] >= 3
+    # the stack is still healthy after the chaos
+    resp = tpu_client.chat.completions.create(messages=msgs, model="tiny", n=3, seed=99)
+    assert resp.degraded is None and len(resp.choices) == 4

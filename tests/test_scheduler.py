@@ -321,3 +321,102 @@ def test_concurrent_traced_requests_keep_their_own_spec_stats(monkeypatch):
             "verify_iterations" in spec
             or spec.get("mode") == "sp_decode_fallback"
         ), spec
+
+
+# -- request-lifecycle hardening: cancellation + shutdown -----------------
+
+
+def test_cancelled_future_never_reaches_engine():
+    """A queued request whose future is cancelled before admission to the
+    worker must never run: set_running_or_notify_cancel filters it out."""
+    sched = EngineScheduler(name="t-cancel")
+    gate = threading.Event()
+    ran = []
+
+    blocker = sched.submit(lambda: gate.wait(5))
+    victim = sched.submit(lambda: ran.append(1))
+    assert victim.cancel()  # still queued behind the blocker
+    gate.set()
+    blocker.result(timeout=5)
+    assert sched.call(lambda: "drain") == "drain"  # queue fully drained
+    assert ran == []
+    assert victim.cancelled()
+    sched.shutdown()
+
+
+def test_budget_cancelled_queued_request_shed_before_engine():
+    """A budget cancelled while the item waits in the queue sheds at dequeue:
+    the batch runner is never invoked for it and the caller gets the typed
+    cancellation error."""
+    from k_llms_tpu.reliability.deadline import RequestBudget
+    from k_llms_tpu.types.wire import RequestCancelledError
+
+    sched = EngineScheduler(name="t-shed")
+    gate = threading.Event()
+    runner_sizes = []
+
+    def runner(payloads):
+        runner_sizes.append(len(payloads))
+        return list(payloads)
+
+    blocker = sched.submit(lambda: gate.wait(5))
+    budget = RequestBudget.from_timeout(None)
+    fut = sched.submit_batched(("k",), "p", runner, budget=budget)
+    budget.cancel()
+    gate.set()
+    blocker.result(timeout=5)
+    with pytest.raises(RequestCancelledError):
+        fut.result(timeout=5)
+    assert sched.call(lambda: 1) == 1
+    assert runner_sizes == []  # shed item never reached the runner
+    assert sched.stats["shed"] == 1
+    sched.shutdown()
+
+
+def test_expired_budget_rejected_at_admission():
+    """Work arriving with an already-spent budget is rejected at submit time
+    (typed error on the future) instead of occupying queue space."""
+    from k_llms_tpu.reliability.deadline import RequestBudget
+    from k_llms_tpu.types.wire import RequestTimeoutError
+
+    sched = EngineScheduler(name="t-adm")
+    ran = []
+    fut = sched.submit(lambda: ran.append(1), budget=RequestBudget.from_timeout(0.0))
+    with pytest.raises(RequestTimeoutError):
+        fut.result(timeout=1)
+    assert sched.call(lambda: "after") == "after"
+    assert ran == []
+    assert sched.stats["shed"] == 1
+    sched.shutdown()
+
+
+def test_shutdown_joins_worker_with_work_in_flight():
+    """shutdown() while the worker is mid-closure: the in-flight work
+    completes, the sentinel drains, and the worker thread joins cleanly."""
+    sched = EngineScheduler(name="t-down")
+    started = threading.Event()
+
+    def slow():
+        started.set()
+        time.sleep(0.2)
+        return "done"
+
+    fut = sched.submit(slow)
+    assert started.wait(5)
+    sched.shutdown()  # posted behind the in-flight item; join(timeout=5)
+    assert not sched._worker.is_alive()
+    assert fut.result(timeout=0) == "done"
+
+
+def test_shutdown_with_queued_backlog_serves_backlog_first():
+    """The shutdown sentinel is FIFO like everything else: items queued
+    before shutdown() still run to completion before the worker exits."""
+    sched = EngineScheduler(name="t-down2")
+    gate = threading.Event()
+    blocker = sched.submit(lambda: gate.wait(5))
+    queued = [sched.submit(lambda i=i: i * i) for i in range(4)]
+    gate.set()
+    sched.shutdown()
+    assert blocker.result(timeout=0) is True
+    assert [f.result(timeout=0) for f in queued] == [0, 1, 4, 9]
+    assert not sched._worker.is_alive()
