@@ -76,7 +76,12 @@ class Backend(abc.ABC):
         breaker = self.circuit_breaker
 
         def attempt() -> ChatCompletion:
-            from ..types.wire import RequestCancelledError, RequestTimeoutError
+            from ..types.wire import (
+                RateLimitError,
+                RequestCancelledError,
+                RequestTimeoutError,
+                ServerDrainingError,
+            )
 
             breaker.allow()
             try:
@@ -85,7 +90,18 @@ class Backend(abc.ABC):
             except BaseException as e:
                 # A caller's own deadline/cancel is not a backend-health
                 # signal — only genuine dispatch faults trip the circuit.
-                if not isinstance(e, (RequestTimeoutError, RequestCancelledError)):
+                # Admission sheds (queue full, draining) are LOAD signals:
+                # counting them as failures would latch the circuit open
+                # exactly when the backend is healthy but busy.
+                if not isinstance(
+                    e,
+                    (
+                        RequestTimeoutError,
+                        RequestCancelledError,
+                        RateLimitError,
+                        ServerDrainingError,
+                    ),
+                ):
                     breaker.record_failure()
                 raise
             breaker.record_success()
@@ -148,6 +164,23 @@ class Backend(abc.ABC):
         `consensus_utils.py:1026-1048` hardcodes gpt-5-mini; local backends answer
         with their own model). Default: medoid-free fallback to first value."""
         return values[0]
+
+    def health(self) -> Dict[str, Any]:
+        """Point-in-time serving-health snapshot (shaped for a /healthz
+        endpoint). Backends without a scheduler report their breaker state;
+        TpuBackend overrides with the full scheduler lifecycle view."""
+        breaker = self.__dict__.get("_circuit_breaker")
+        return {
+            "state": "ready",
+            "breaker": breaker.state if breaker is not None else "closed",
+        }
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: stop admission, finish in-flight work, release
+        resources. Returns True when everything completed within ``timeout``.
+        Backends without a request queue just close."""
+        self.close()
+        return True
 
     def close(self) -> None:  # pragma: no cover - optional
         pass

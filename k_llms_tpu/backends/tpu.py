@@ -118,6 +118,111 @@ class BackendConfig(BaseModel):
     # loop drafts each row from its own request's prompt table), so the
     # window no longer trades speculation away for batch throughput.
     batch_window: float = 0.005
+    # -- overload protection (PR 2) --------------------------------------
+    # Bounded admission: total queued weight (device rows, i.e. dp-rounded n
+    # per request) above which new work is shed with a typed 429 instead of
+    # queuing unboundedly. None = unbounded (the pre-PR-2 behavior).
+    max_queue_weight: Optional[int] = None
+    # Hard cap on the coalesced device batch (rows). None = the scheduler's
+    # default (64), further tightened per request by the HBM memory model.
+    max_batch_rows: Optional[int] = None
+    # Per-device HBM for the memory model. None = autodetect from
+    # device.memory_stats() (falls back to 16 GiB when the platform doesn't
+    # report, e.g. CPU meshes — effectively unbounded for test models).
+    hbm_bytes: Optional[int] = None
+    # Fraction of HBM the memory model may plan against; the rest absorbs
+    # XLA temporaries, fragmentation, and compile-time scratch.
+    hbm_headroom: float = 0.85
+    # Default timeout for drain()/close() graceful shutdown.
+    drain_timeout: float = 30.0
+
+
+def _detect_hbm_bytes() -> Optional[int]:
+    """Per-device memory limit from the PJRT runtime, or None when the
+    platform doesn't report one (CPU, some plugins)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if limit:
+                return int(limit)
+    except Exception:  # pragma: no cover - platform-dependent
+        pass
+    return None
+
+
+class HbmMemoryModel:
+    """Static HBM accounting for the coalesced decode: how many device rows
+    (samples) fit alongside the resident parameters?
+
+    Per-device footprint of an R-row decode at sequence length S:
+
+        params / tp                               (weights, sharded over TP)
+      + (R / dp) * S * kv_bytes_per_token / tp    (KV cache; heads shard TP,
+                                                   rows shard DP)
+      + (R / dp) * row_margin                     (logits f32 + sampling state)
+
+    Inverting for R against ``hbm * headroom`` gives the row cap the
+    scheduler may coalesce to for a given request shape. Deliberately
+    conservative and static — it exists to keep the FIRST launch from
+    exceeding HBM; the engine's OOM guard (split-and-requeue) catches what
+    the model underestimates."""
+
+    def __init__(
+        self,
+        config,
+        param_bytes: int,
+        hbm_bytes: Optional[int] = None,
+        headroom: float = 0.85,
+        tp: int = 1,
+        dp: int = 1,
+    ):
+        self.config = config
+        self.param_bytes = int(param_bytes)
+        detected = hbm_bytes if hbm_bytes is not None else _detect_hbm_bytes()
+        # 16 GiB (v5e-class) fallback: on platforms with no reported limit
+        # (CPU test meshes with toy models) this yields caps far above the
+        # scheduler's max_rows, i.e. the model imposes nothing.
+        self.hbm_bytes = int(detected) if detected else 16 * (1 << 30)
+        self.headroom = float(headroom)
+        self.tp = max(1, int(tp))
+        self.dp = max(1, int(dp))
+        itemsize = np.dtype(config.jax_dtype).itemsize
+        # K and V, every layer, kv_dim features per token; KV heads shard
+        # over the model axis with the attention that consumes them.
+        self.kv_bytes_per_token = 2 * config.num_layers * config.kv_dim * itemsize
+        # Per-row non-KV working set: the decode loop materializes f32 logits
+        # and sampling buffers per row; 4 bytes * vocab is the dominant term.
+        self.row_margin_bytes = 4 * config.vocab_size + (64 << 10)
+
+    def budget_bytes(self) -> int:
+        """Bytes available for per-row state after params, per device."""
+        return int(self.hbm_bytes * self.headroom) - self.param_bytes // self.tp
+
+    def max_rows(self, seq_len: int) -> int:
+        """Row cap for a decode whose rows each hold ``seq_len`` tokens of KV
+        (prompt + max_new). Always >= 1: a single row that doesn't fit is the
+        OOM guard's problem, not admission's — failing it here would turn an
+        optimistic estimate into a hard rejection."""
+        seq_len = max(1, int(seq_len))
+        per_row = (
+            seq_len * self.kv_bytes_per_token // self.tp + self.row_margin_bytes
+        )
+        rows = self.dp * max(0, self.budget_bytes()) // max(1, per_row)
+        return max(1, int(rows))
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "hbm_bytes": self.hbm_bytes,
+            "headroom": self.headroom,
+            "param_bytes": self.param_bytes,
+            "kv_bytes_per_token": self.kv_bytes_per_token,
+            "tp": self.tp,
+            "dp": self.dp,
+            "max_rows_at_max_seq": self.max_rows(self.config.max_seq_len),
+        }
 
 
 class TpuBackend(Backend):
@@ -188,13 +293,42 @@ class TpuBackend(Backend):
             spec_lookahead=cfg.spec_lookahead,
         )
         self.default_max_new_tokens = cfg.max_new_tokens
+        # HBM memory model: caps the rows any coalesced group may fuse to for
+        # a given request shape (prompt + max_new KV per row), per-request via
+        # the scheduler's max_rows hint. TP degree = the engine mesh's model
+        # axis; params measured from the resident tree (quantization included).
+        mp = 1
+        if self.engine.mesh is not None:
+            from ..parallel.mesh import MODEL_AXIS
+
+            mp = self.engine.mesh.shape.get(MODEL_AXIS, 1)
+        self.memory_model = HbmMemoryModel(
+            self.engine.config,
+            param_bytes=self.engine.param_footprint_bytes(),
+            hbm_bytes=cfg.hbm_bytes,
+            headroom=cfg.hbm_headroom,
+            tp=mp,
+            dp=self.engine.data_parallel_size,
+        )
         # All device work funnels through one scheduler so concurrent clients
         # (AsyncKLLMs, threads) serialize cleanly instead of racing jit caches.
         from ..engine.scheduler import EngineScheduler
 
+        scheduler_kwargs: Dict[str, Any] = {}
+        if cfg.max_batch_rows is not None:
+            scheduler_kwargs["max_rows"] = cfg.max_batch_rows
         self.scheduler = EngineScheduler(
-            name=self.model_name, batch_window=cfg.batch_window
+            name=self.model_name,
+            batch_window=cfg.batch_window,
+            max_queue_weight=cfg.max_queue_weight,
+            **scheduler_kwargs,
         )
+        # Device-OOM feedback loop: the engine's guard reports each caught
+        # RESOURCE_EXHAUSTED (scheduler halves its coalescing width) and each
+        # clean launch (width steps back up, DEGRADED clears).
+        self.engine.on_oom = self.scheduler.note_oom
+        self.engine.on_launch_ok = self.scheduler.note_recovered
+        self._closed = False
         self._dfa_cache: Dict[str, Any] = {}
 
     # -- chat -------------------------------------------------------------
@@ -435,7 +569,9 @@ class TpuBackend(Backend):
 
         # Weight = this request's padded row count (the engine rounds n up to a
         # data-parallel multiple), so the scheduler's max_rows bound tracks the
-        # batch the device will actually see.
+        # batch the device will actually see. max_rows = the HBM memory
+        # model's row cap for THIS request's KV length — any group this item
+        # joins is clipped to the tightest member hint.
         dp = self.engine.data_parallel_size
         rows = ((max(1, n) + dp - 1) // dp) * dp
         return self.scheduler.call_batched(
@@ -444,6 +580,7 @@ class TpuBackend(Backend):
             run,
             weight=rows,
             budget=budget,
+            max_rows=self.memory_model.max_rows(len(prompt_ids) + max_new),
         )
 
     def _constraint_for(self, response_format: Any):
@@ -561,6 +698,32 @@ class TpuBackend(Backend):
             else tok.decode(tok.encode(t)[:max_tokens])
             for t in texts
         ]
+
+    # -- lifecycle --------------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """Serving-health snapshot: scheduler lifecycle state + queue/shed
+        counters, breaker state, engine OOM stats, and the memory model's
+        planning view. Cheap — no device work."""
+        snap = self.scheduler.health()
+        snap["breaker"] = self.circuit_breaker.state
+        snap["engine_oom"] = dict(self.engine.oom_stats)
+        snap["memory_model"] = self.memory_model.describe()
+        return snap
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: close admission (new requests get a typed 503),
+        finish queued + in-flight groups, join the scheduler worker. Returns
+        True when everything completed within ``timeout`` (default:
+        ``BackendConfig.drain_timeout``). Idempotent."""
+        self._closed = True
+        return self.scheduler.drain(
+            timeout=self.backend_config.drain_timeout if timeout is None else timeout
+        )
+
+    def close(self) -> None:
+        if self._closed and self.scheduler.state.value == "stopped":
+            return
+        self.drain()
 
     # -- llm-consensus ----------------------------------------------------
     def llm_consensus(self, values: List[str]) -> str:

@@ -114,6 +114,26 @@ class _BaseKLLMs:
         """The underlying engine (the reference exposes its OpenAI client here)."""
         return self._backend
 
+    # -- lifecycle --------------------------------------------------------
+    def health(self) -> Any:
+        """Serving-health snapshot from the backend (scheduler lifecycle
+        state, queue depth/weight, shed/OOM counters, breaker state)."""
+        return self._backend.health()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Gracefully stop serving: admission closes (new requests get a
+        typed 503 ``ServerDrainingError``), in-flight and queued work
+        finishes, the worker joins. Returns True when everything completed
+        within ``timeout`` (None = the backend's configured default)."""
+        if timeout is None:
+            return self._backend.drain()
+        return self._backend.drain(timeout=timeout)
+
+    def close(self) -> None:
+        """Drain and release the backend. Idempotent; also runs on
+        context-manager exit."""
+        self._backend.close()
+
     def get_embeddings(
         self,
         texts: List[str],
@@ -143,11 +163,26 @@ class KLLMs(_BaseKLLMs):
         super().__init__(**kwargs)
         self.chat = Chat(self)
 
+    def __enter__(self) -> "KLLMs":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
 
 class AsyncKLLMs(_BaseKLLMs):
     def __init__(self, **kwargs: Any):
         super().__init__(**kwargs)
         self.chat = AsyncChat(self)
+
+    async def __aenter__(self) -> "AsyncKLLMs":
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        import asyncio
+
+        # drain() blocks on in-flight decodes; keep the event loop free.
+        await asyncio.to_thread(self.close)
 
     async def async_get_embeddings(
         self,

@@ -48,6 +48,7 @@ from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, auto_mesh
 from ..parallel.sharding import batch_spec, cache_specs, param_specs
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
+from ..types.wire import BackendUnavailableError, KLLMsError
 from ..utils.observability import FAILURE_EVENTS
 
 logger = logging.getLogger(__name__)
@@ -57,6 +58,22 @@ MAX_EOS_IDS = 4
 # up to this many tokens (longer stops degrade to host-side text truncation).
 MAX_STOP_SEQS = 4
 MAX_STOP_LEN = 8
+
+# A coalesced group is split at most this many times on device OOM before its
+# members fail (2**5 = a 32-request group degrades all the way to solo).
+MAX_OOM_SPLITS = 5
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    """Is this the device's out-of-memory signal? jaxlib surfaces HBM
+    exhaustion as XlaRuntimeError("RESOURCE_EXHAUSTED: ..."), and PJRT plugins
+    vary the exception class but keep the gRPC status name in the message —
+    so match on the marker, not the type. Typed lifecycle errors are never
+    OOM even if a message embeds the marker."""
+    if isinstance(e, KLLMsError):
+        return False
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
 
 
 def stop_window_match(window: jax.Array, stops: jax.Array) -> jax.Array:
@@ -371,6 +388,14 @@ class LocalEngine:
         # tokens_per_iteration) — the knob users tune spec_lookahead against.
         self.spec_stats: Dict[str, Any] = {}
 
+        # Device-OOM recovery (PR 2): generate_many catches RESOURCE_EXHAUSTED
+        # from a coalesced launch and recursively halves the group instead of
+        # failing every member. The scheduler subscribes via these hooks to
+        # back off / restore its coalescing width.
+        self.oom_stats: Dict[str, int] = {"splits": 0, "unrecovered": 0}
+        self.on_oom: Optional[Any] = None  # called once per caught device OOM
+        self.on_launch_ok: Optional[Any] = None  # called after clean launches
+
         self._prefill_cache: Dict[Any, Any] = {}
         self._sp_prefill_cache: Dict[Any, Any] = {}
         self._sp_continue_cache: Dict[Any, Any] = {}
@@ -393,6 +418,19 @@ class LocalEngine:
         if self.mesh is None:
             return 1
         return self.mesh.shape[DATA_AXIS]
+
+    def param_footprint_bytes(self) -> int:
+        """Total bytes of the resident parameter tree (sum over leaves; a
+        quantized tree reports its quantized size). Feeds the backend's HBM
+        memory model — measured from the actual leaves rather than re-derived
+        from the config so quantization/layout choices are automatically
+        reflected."""
+        total = 0
+        for leaf in jax.tree.leaves(self.params):
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is not None:
+                total += int(nbytes)
+        return total
 
     # -- prefill ----------------------------------------------------------
     def _get_prefill(self, bucket: int):
@@ -1774,6 +1812,66 @@ class LocalEngine:
         self,
         items: Sequence[GenRequestSpec],
         *,
+        _oom_splits_left: int = MAX_OOM_SPLITS,
+        **kwargs,
+    ) -> List[Any]:
+        """Decode several same-config requests as one batched XLA program,
+        with device-OOM recovery: a launch that dies with RESOURCE_EXHAUSTED
+        splits the group in half and retries each half at the reduced width
+        (recursively, bounded by ``MAX_OOM_SPLITS``) instead of failing every
+        member. A solo request that still OOMs gets a typed 503 member error —
+        it genuinely does not fit. Splits are counted in ``FAILURE_EVENTS``
+        and ``oom_stats``; ``on_oom``/``on_launch_ok`` notify the scheduler so
+        it can back off its coalescing width (see
+        ``EngineScheduler.note_oom``). See :meth:`_generate_many_attempt` for
+        the decode semantics."""
+        if not items:
+            return []
+        try:
+            results = self._generate_many_attempt(items, **kwargs)
+        except Exception as e:
+            if not is_resource_exhausted(e):
+                raise
+            FAILURE_EVENTS.record("engine.oom")
+            self.oom_stats["splits"] += 1
+            if self.on_oom is not None:
+                self.on_oom()
+            if len(items) == 1 or _oom_splits_left <= 0:
+                self.oom_stats["unrecovered"] += len(items)
+                FAILURE_EVENTS.record("engine.oom_unrecovered", len(items))
+                logger.error(
+                    "device OOM not recoverable by splitting (%d member(s)): %s",
+                    len(items),
+                    e,
+                )
+                return [
+                    BackendUnavailableError(
+                        f"device out of memory decoding this request "
+                        f"(n={it.n}, prompt_len={len(it.prompt_ids)}); "
+                        "reduce n or max_tokens"
+                    )
+                    for it in items
+                ]
+            mid = (len(items) + 1) // 2
+            logger.warning(
+                "device OOM on a %d-request coalesced launch; splitting "
+                "%d/%d and retrying (%d split(s) left)",
+                len(items), mid, len(items) - mid, _oom_splits_left - 1,
+            )
+            FAILURE_EVENTS.record("engine.oom_split")
+            return self.generate_many(
+                items[:mid], _oom_splits_left=_oom_splits_left - 1, **kwargs
+            ) + self.generate_many(
+                items[mid:], _oom_splits_left=_oom_splits_left - 1, **kwargs
+            )
+        if self.on_launch_ok is not None:
+            self.on_launch_ok()
+        return results
+
+    def _generate_many_attempt(
+        self,
+        items: Sequence[GenRequestSpec],
+        *,
         max_new_tokens: int = 128,
         temperature: float = 1.0,
         top_p: Optional[float] = None,
@@ -1802,6 +1900,7 @@ class LocalEngine:
         returned list instead of a GenerationResult — the scheduler delivers
         it to just that member's caller; the rest of the batch is unaffected.
         """
+        _failpoints.fire("engine.launch")
         if not items:
             return []
         if len(items) == 1:
@@ -1828,7 +1927,11 @@ class LocalEngine:
                 ]
             except Exception as e:
                 # Same contract as the coalesced path: member failures are
-                # list elements, not batch poison.
+                # list elements, not batch poison — EXCEPT the device OOM
+                # signal, which the generate_many guard must see to convert
+                # into a typed error (or it would vanish into the member).
+                if is_resource_exhausted(e):
+                    raise
                 return [e]
 
         config = self.config

@@ -22,6 +22,18 @@ program. The window costs a genuinely-solo request ~5 ms on a ~1 s decode
 (<1%) and applies only to batchable work — plain ``submit`` closures run
 immediately.
 
+Overload protection (PR 2): the queue is optionally *bounded by weight*
+(``max_queue_weight``) — weight being the same device-row cost used for the
+coalescing bound, so the cap tracks HBM pressure rather than request count.
+Work that would push the queue past the cap is shed at admission with a typed
+429 (:class:`~k_llms_tpu.types.wire.RateLimitError`) whose ``retry_after`` is
+derived from the measured drain rate, unless a strictly-lower-priority queued
+item can be evicted in its place. The scheduler also owns the process
+lifecycle: a :class:`ServerState`, a ``health()`` snapshot, and
+``drain(timeout)`` which closes admission (typed 503), finishes in-flight
+groups, and joins the worker. Device OOM feedback arrives via ``note_oom()``
+(halves the effective coalescing width) / ``note_recovered()`` (restores it).
+
 Callers get ``concurrent.futures.Future``s; ``AsyncKLLMs`` awaits them without
 blocking the event loop. Queue depth and service counts are exposed for
 observability.
@@ -29,6 +41,7 @@ observability.
 
 from __future__ import annotations
 
+import enum
 import logging
 import threading
 import time
@@ -38,6 +51,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
+from ..types.wire import BackendUnavailableError, RateLimitError, ServerDrainingError
 from ..utils.observability import FAILURE_EVENTS
 
 logger = logging.getLogger(__name__)
@@ -45,6 +59,26 @@ logger = logging.getLogger(__name__)
 
 def _next_pow2(n: int) -> int:
     return 1 << (max(1, n) - 1).bit_length()
+
+
+class ServerState(str, enum.Enum):
+    """Lifecycle of a serving scheduler. Owned by the scheduler because the
+    scheduler is the single choke point every request passes through — state
+    transitions and admission decisions share one lock.
+
+    STARTING  worker thread not yet running (transient, microseconds).
+    READY     serving normally.
+    DEGRADED  serving, but a device OOM forced the coalescing width down;
+              clears back to READY once launches succeed at full width.
+    DRAINING  admission closed (503); in-flight + queued work finishing.
+    STOPPED   worker joined; all submission rejected.
+    """
+
+    STARTING = "starting"
+    READY = "ready"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+    STOPPED = "stopped"
 
 
 class _Item:
@@ -57,6 +91,8 @@ class _Item:
         "weight",
         "window",
         "budget",
+        "priority",
+        "max_rows",
     )
 
     def __init__(
@@ -69,6 +105,8 @@ class _Item:
         weight=1,
         window=None,
         budget=None,
+        priority=0,
+        max_rows=None,
     ):
         self.future = future
         self.fn = fn
@@ -78,6 +116,14 @@ class _Item:
         self.weight = weight
         self.window = window
         self.budget = budget
+        self.priority = priority
+        self.max_rows = max_rows
+
+
+# Rolling window (seconds) over which the drain rate backing ``retry_after``
+# estimates is measured. Long enough to smooth over one multi-second decode,
+# short enough to track a load shift.
+_DRAIN_WINDOW_S = 30.0
 
 
 class EngineScheduler:
@@ -90,7 +136,11 @@ class EngineScheduler:
     a group is ``len(group) * max(weight)`` — a group stops growing once
     admitting the next item would push that product past ``max_rows``. This
     bounds HBM: five queued n=32 consensus requests do NOT fuse into one
-    160-row decode."""
+    160-row decode.
+
+    ``max_queue_weight`` (None = unbounded, the pre-PR-2 behavior) bounds the
+    total weight of *queued* work; see the module docstring for the shedding
+    contract."""
 
     def __init__(
         self,
@@ -98,6 +148,7 @@ class EngineScheduler:
         max_batch: int = 8,
         max_rows: int = 64,
         batch_window: float = 0.005,
+        max_queue_weight: Optional[int] = None,
     ):
         self._items: "deque[Optional[_Item]]" = deque()
         self._cv = threading.Condition()
@@ -106,13 +157,61 @@ class EngineScheduler:
         self._batches = 0
         self._coalesced = 0
         self._shed = 0
+        self._shed_over_capacity = 0
+        self._evicted = 0
+        self._oom_splits = 0
+        self._queue_weight = 0
+        self._in_flight = 0
+        self._state = ServerState.STARTING
+        # Adaptive-width backoff: effective row cap is max_rows >> _width_shift.
+        self._width_shift = 0
+        self._ok_since_backoff = 0
+        # (monotonic_time, weight) samples of recently completed work, for the
+        # drain-rate estimate behind RateLimitError.retry_after.
+        self._drained: "deque[Tuple[float, int]]" = deque()
         self.max_batch = max_batch
         self.max_rows = max_rows
         self.batch_window = batch_window
+        self.max_queue_weight = max_queue_weight
         self._worker = threading.Thread(
             target=self._run, name=f"kllms-{name}-worker", daemon=True
         )
         self._worker.start()
+
+    # -- adaptive width ----------------------------------------------------
+    def _effective_max_rows(self) -> int:
+        """Row cap after OOM backoff (caller holds no lock; reads are atomic
+        enough for an admission heuristic)."""
+        return max(1, self.max_rows >> self._width_shift)
+
+    def note_oom(self) -> None:
+        """Device OOM observed on a batch launch: halve the coalescing width
+        so subsequent groups fuse less aggressively, and mark DEGRADED. Safe
+        to call from the worker thread (the engine's OOM guard) or elsewhere."""
+        with self._cv:
+            self._oom_splits += 1
+            if (self.max_rows >> self._width_shift) > 1:
+                self._width_shift += 1
+            self._ok_since_backoff = 0
+            if self._state is ServerState.READY:
+                self._state = ServerState.DEGRADED
+        logger.warning(
+            "scheduler: device OOM — coalescing width backed off to %d rows",
+            self._effective_max_rows(),
+        )
+
+    def note_recovered(self) -> None:
+        """A batch launch succeeded. After a few consecutive successes, step
+        the width back up; once fully restored, DEGRADED clears to READY."""
+        with self._cv:
+            if self._width_shift == 0:
+                return
+            self._ok_since_backoff += 1
+            if self._ok_since_backoff >= 3:
+                self._width_shift -= 1
+                self._ok_since_backoff = 0
+                if self._width_shift == 0 and self._state is ServerState.DEGRADED:
+                    self._state = ServerState.READY
 
     # -- worker -----------------------------------------------------------
     def _next_group(self) -> Optional[List[_Item]]:
@@ -122,14 +221,28 @@ class EngineScheduler:
         (different-key / over-budget / shutdown) item at its head."""
         with self._cv:
             while not self._items:
+                if self._state in (ServerState.DRAINING, ServerState.STOPPED):
+                    # Draining/stopped with an empty queue: nothing more can
+                    # be admitted, so the worker retires without a sentinel.
+                    return None
                 self._cv.wait()
             head = self._items.popleft()
             if head is None:
                 return None
+            self._queue_weight -= head.weight
             if head.batch_key is None:
+                self._in_flight += 1
                 return [head]
             group = [head]
             max_w = head.weight
+            # Row cap for THIS group: global knob, OOM backoff, and any
+            # per-item HBM hint from the backend's memory model. Hints of
+            # later-admitted members tighten the cap mid-coalesce.
+            cap = min(
+                self.max_rows >> self._width_shift,
+                head.max_rows if head.max_rows is not None else self.max_rows,
+            )
+            cap = max(1, cap)
             window = self.batch_window if head.window is None else head.window
             # The admission window must never outlive the tightest deadline in
             # the group: a member with 3 ms of budget left cannot afford a 5 ms
@@ -140,6 +253,8 @@ class EngineScheduler:
             while len(group) < self.max_batch:
                 if self._items:
                     nxt = self._items[0]
+                    if nxt is not None and nxt.max_rows is not None:
+                        cap = max(1, min(cap, nxt.max_rows))
                     if (
                         nxt is None
                         or nxt.batch_key != head.batch_key
@@ -148,22 +263,25 @@ class EngineScheduler:
                         # compile bucketing), so admit against
                         # next_pow2(len+1) * max weight. Callers pass weights
                         # already rounded to their device-batch granularity.
-                        or _next_pow2(len(group) + 1) * max(max_w, nxt.weight)
-                        > self.max_rows
+                        or _next_pow2(len(group) + 1) * max(max_w, nxt.weight) > cap
                     ):
                         break  # FIFO fairness: never reach around the head
                     self._items.popleft()
+                    self._queue_weight -= nxt.weight
                     max_w = max(max_w, nxt.weight)
                     group.append(nxt)
                     if nxt.budget is not None:
                         deadline = min(deadline, nxt.budget.deadline.at)
                     continue
-                if _next_pow2(len(group) + 1) * max_w > self.max_rows:
+                if _next_pow2(len(group) + 1) * max_w > cap:
                     break  # even a weight-1 arrival couldn't be admitted
+                if self._state is ServerState.DRAINING:
+                    break  # nothing new can arrive; launch what we have
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._cv.wait(remaining)
+            self._in_flight += 1
             return group
 
     def _shed_spent(self, items: List[_Item]) -> List[_Item]:
@@ -186,7 +304,31 @@ class EngineScheduler:
             FAILURE_EVENTS.record("scheduler.shed", shed)
         return live
 
+    def _record_drained(self, weight: int) -> None:
+        """Caller holds self._cv. Feeds the rolling drain-rate window."""
+        now = time.monotonic()
+        self._drained.append((now, weight))
+        horizon = now - _DRAIN_WINDOW_S
+        while self._drained and self._drained[0][0] < horizon:
+            self._drained.popleft()
+
+    def _group_done(self, group: List[_Item], served: int, errors: int) -> None:
+        with self._cv:
+            self._in_flight -= 1
+            self._served += served
+            self._errors += errors
+            self._record_drained(sum(it.weight for it in group))
+            if served and group[0].batch_key is not None:
+                self._batches += 1
+                self._coalesced += served - 1
+            # drain() waits on queue-empty AND in-flight-zero.
+            self._cv.notify_all()
+
     def _run(self) -> None:
+        with self._cv:
+            if self._state is ServerState.STARTING:
+                self._state = ServerState.READY
+            self._cv.notify_all()
         while True:
             group = self._next_group()
             if group is None:
@@ -194,6 +336,7 @@ class EngineScheduler:
             live = [it for it in group if it.future.set_running_or_notify_cancel()]
             live = self._shed_spent(live)
             if not live:
+                self._group_done(group, served=0, errors=0)
                 continue
             try:
                 if live[0].batch_key is None:
@@ -216,47 +359,145 @@ class EngineScheduler:
                             it.future.set_exception(res)
                         else:
                             it.future.set_result(res)
-                    if n_failed:
-                        with self._cv:
-                            self._errors += n_failed
-                with self._cv:
-                    self._served += len(live)
-                    if live[0].batch_key is not None:
-                        self._batches += 1
-                        self._coalesced += len(live) - 1
+                    self._group_done(group, served=len(live), errors=n_failed)
+                    continue
+                self._group_done(group, served=len(live), errors=0)
             except BaseException as e:  # deliver to the caller(s), keep serving
-                with self._cv:
-                    self._errors += len(live)
                 for it in live:
                     if not it.future.done():
                         it.future.set_exception(e)
+                self._group_done(group, served=0, errors=len(live))
 
-    # -- submission -------------------------------------------------------
+    # -- admission --------------------------------------------------------
+    def _drain_rate(self) -> float:
+        """Weight served per second over the rolling window (caller holds
+        self._cv). Falls back to 0.0 when there is no history."""
+        if len(self._drained) < 2:
+            return 0.0
+        span = self._drained[-1][0] - self._drained[0][0]
+        if span <= 0:
+            return 0.0
+        return sum(w for _, w in self._drained) / span
+
+    def _retry_after(self, weight: int) -> float:
+        """Seconds until queued weight should have drained enough to admit
+        ``weight`` more (caller holds self._cv). Clamped to [0.1, 60]."""
+        rate = self._drain_rate()
+        backlog = self._queue_weight + weight
+        est = backlog / rate if rate > 0 else 1.0
+        return min(60.0, max(0.1, est))
+
+    def _try_evict_for(self, weight: int, priority: int) -> List[_Item]:
+        """Caller holds self._cv. Frees capacity for an incoming item by
+        evicting strictly-lower-priority queued items (higher ``priority``
+        int = less important), scanning from the back of the queue (newest,
+        least sunk wait first). Returns the evicted items — their futures must
+        be failed AFTER the lock is released (Future callbacks run inline) —
+        or [] if enough capacity cannot be freed this way."""
+        assert self.max_queue_weight is not None
+        need = self._queue_weight + weight - self.max_queue_weight
+        victims: List[_Item] = []
+        freed = 0
+        for it in reversed(self._items):
+            if it is None:
+                continue
+            if it.priority > priority:
+                victims.append(it)
+                freed += it.weight
+                if freed >= need:
+                    break
+        if freed < need:
+            return []
+        for v in victims:
+            self._items.remove(v)
+            self._queue_weight -= v.weight
+        return victims
+
+    def _admit(self, item: _Item) -> bool:
+        """Admission control, atomic with the queue append: lifecycle state
+        gate (DRAINING/STOPPED → typed 503), spent-budget rejection, and the
+        ``max_queue_weight`` capacity check with priority-aware eviction.
+        Also hosts the ``scheduler.admit`` failpoint. Returns False when the
+        item was rejected (its future already carries the typed error)."""
+        future = item.future
+        _failpoints.fire("scheduler.admit")
+        if item.budget is not None and item.budget.should_abort():
+            with self._cv:
+                self._shed += 1
+            FAILURE_EVENTS.record("scheduler.shed")
+            future.set_exception(item.budget.error("scheduler admission"))
+            return False
+        evicted: List[_Item] = []
+        rejection: Optional[BaseException] = None
+        with self._cv:
+            if self._state is ServerState.STOPPED:
+                rejection = BackendUnavailableError(
+                    "scheduler is stopped; no further work is accepted"
+                )
+            elif self._state is ServerState.DRAINING:
+                rejection = ServerDrainingError(
+                    "server is draining; retry against another replica"
+                )
+            elif (
+                self.max_queue_weight is not None
+                and self._queue_weight + item.weight > self.max_queue_weight
+            ):
+                evicted = self._try_evict_for(item.weight, item.priority)
+                if not evicted and (
+                    self._queue_weight + item.weight > self.max_queue_weight
+                ):
+                    rejection = RateLimitError(
+                        f"queue at capacity (weight {self._queue_weight}/"
+                        f"{self.max_queue_weight}); request weight "
+                        f"{item.weight} rejected",
+                        retry_after=self._retry_after(item.weight),
+                    )
+            if rejection is None:
+                self._items.append(item)
+                self._queue_weight += item.weight
+                self._shed += len(evicted)
+                self._shed_over_capacity += len(evicted)
+                self._evicted += len(evicted)
+                self._cv.notify()
+            else:
+                self._shed += 1
+                if isinstance(rejection, RateLimitError):
+                    self._shed_over_capacity += 1
+        # Futures are completed outside the lock: set_exception runs caller
+        # callbacks inline, and a callback that re-enters the scheduler
+        # (e.g. a retry) must not deadlock on self._cv.
+        if evicted:
+            FAILURE_EVENTS.record("scheduler.shed_over_capacity", len(evicted))
+            for v in evicted:
+                if not v.future.done():
+                    v.future.set_exception(
+                        RateLimitError(
+                            "evicted from queue by higher-priority work",
+                            retry_after=1.0,
+                        )
+                    )
+        if rejection is not None:
+            if isinstance(rejection, RateLimitError):
+                FAILURE_EVENTS.record("scheduler.shed_over_capacity")
+            else:
+                FAILURE_EVENTS.record("scheduler.shed_draining")
+            future.set_exception(rejection)
+            return False
+        return True
+
     def _put(self, item: Optional[_Item]) -> None:
         with self._cv:
             self._items.append(item)
             self._cv.notify()
 
-    def _admit(self, future: Future, budget: Optional[RequestBudget]) -> bool:
-        """Admission control: work arriving with a spent budget is rejected
-        immediately (the future gets the typed error) instead of occupying
-        queue space it can never use. Also hosts the ``scheduler.admit``
-        failpoint. Returns False when the item was rejected."""
-        _failpoints.fire("scheduler.admit")
-        if budget is not None and budget.should_abort():
-            with self._cv:
-                self._shed += 1
-            FAILURE_EVENTS.record("scheduler.shed")
-            future.set_exception(budget.error("scheduler admission"))
-            return False
-        return True
-
     def submit(
-        self, fn: Callable[[], Any], budget: Optional[RequestBudget] = None
+        self,
+        fn: Callable[[], Any],
+        budget: Optional[RequestBudget] = None,
+        priority: int = 0,
     ) -> Future:
         future: Future = Future()
-        if self._admit(future, budget):
-            self._put(_Item(future, fn=fn, budget=budget))
+        self._admit(_Item(future, fn=fn, budget=budget, priority=priority))
         return future
 
     def submit_batched(
@@ -267,31 +508,39 @@ class EngineScheduler:
         weight: int = 1,
         window: Optional[float] = None,
         budget: Optional[RequestBudget] = None,
+        priority: int = 0,
+        max_rows: Optional[int] = None,
     ) -> Future:
         """Enqueue ``payload`` for batched service. Items whose ``batch_key``
         matches the queue head's coalesce into ONE ``batch_fn(payloads)`` call
         (the runner must return one result per payload, in order). Callers with
         equal keys must pass interchangeable runners — the group uses the first
         item's. ``weight`` is the item's device-batch contribution (e.g. its
-        sample count n) for the ``max_rows`` admission bound. ``window``
-        overrides the scheduler's admission window for a group this item
-        heads — pass 0.0 for cheap work (e.g. embedding forwards) where the
-        default 5 ms would be a large relative latency cost. ``budget``
-        attaches the request's lifecycle budget: spent budgets are rejected at
-        admission, shed at dequeue, and bound the coalescing window."""
+        sample count n) for the ``max_rows`` admission bound AND the
+        ``max_queue_weight`` capacity bound. ``window`` overrides the
+        scheduler's admission window for a group this item heads — pass 0.0
+        for cheap work (e.g. embedding forwards) where the default 5 ms would
+        be a large relative latency cost. ``budget`` attaches the request's
+        lifecycle budget: spent budgets are rejected at admission, shed at
+        dequeue, and bound the coalescing window. ``priority`` (lower = more
+        important, default 0) only matters under overload: an arriving item
+        may evict strictly-lower-priority queued items when the queue is full.
+        ``max_rows`` is a per-item cap on the device rows of any group this
+        item joins — the backend's HBM memory model passes its estimate here."""
         future: Future = Future()
-        if self._admit(future, budget):
-            self._put(
-                _Item(
-                    future,
-                    batch_key=batch_key,
-                    payload=payload,
-                    batch_fn=batch_fn,
-                    weight=weight,
-                    window=window,
-                    budget=budget,
-                )
+        self._admit(
+            _Item(
+                future,
+                batch_key=batch_key,
+                payload=payload,
+                batch_fn=batch_fn,
+                weight=weight,
+                window=window,
+                budget=budget,
+                priority=priority,
+                max_rows=max_rows,
             )
+        )
         return future
 
     def call(
@@ -314,6 +563,8 @@ class EngineScheduler:
         weight: int = 1,
         window: Optional[float] = None,
         budget: Optional[RequestBudget] = None,
+        priority: int = 0,
+        max_rows: Optional[int] = None,
     ) -> Any:
         """Synchronous batched submit-and-wait (re-entrant like ``call``).
         Per-member failures surface here: if the runner returned an exception
@@ -326,8 +577,21 @@ class EngineScheduler:
                 raise res
             return res
         return self.submit_batched(
-            batch_key, payload, batch_fn, weight=weight, window=window, budget=budget
+            batch_key,
+            payload,
+            batch_fn,
+            weight=weight,
+            window=window,
+            budget=budget,
+            priority=priority,
+            max_rows=max_rows,
         ).result()
+
+    # -- lifecycle & observability ----------------------------------------
+    @property
+    def state(self) -> ServerState:
+        with self._cv:
+            return self._state
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -341,6 +605,73 @@ class EngineScheduler:
                 "shed": self._shed,
             }
 
+    def health(self) -> Dict[str, Any]:
+        """Point-in-time lifecycle snapshot, shaped for a /healthz endpoint.
+        Cheap (one lock acquisition, no device work)."""
+        with self._cv:
+            return {
+                "state": self._state.value,
+                "queue_depth": sum(1 for it in self._items if it is not None),
+                "queue_weight": self._queue_weight,
+                "max_queue_weight": self.max_queue_weight,
+                "in_flight": self._in_flight,
+                "effective_max_rows": max(1, self.max_rows >> self._width_shift),
+                "max_rows": self.max_rows,
+                "served": self._served,
+                "errors": self._errors,
+                "shed": self._shed,
+                "shed_over_capacity": self._shed_over_capacity,
+                "evicted": self._evicted,
+                "oom_splits": self._oom_splits,
+                "drain_rate": self._drain_rate(),
+            }
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: close admission (new work gets a typed 503),
+        let queued + in-flight groups finish, then join the worker. Returns
+        True when everything completed within ``timeout``; on timeout, still-
+        queued items are failed with the draining 503 and the worker is only
+        joined if it retires promptly (an in-flight decode cannot be killed).
+        Idempotent; callable from any thread except the worker itself."""
+        if threading.current_thread() is self._worker:
+            raise RuntimeError("drain() must not be called from the worker thread")
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            if self._state is ServerState.STOPPED:
+                return True
+            self._state = ServerState.DRAINING
+            self._cv.notify_all()  # wake the worker's idle wait
+            clean = True
+            while self._items or self._in_flight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    clean = False
+                    break
+                self._cv.wait(remaining)
+            leftovers = [it for it in self._items if it is not None]
+            self._items.clear()
+            self._queue_weight = 0
+        for it in leftovers:
+            if not it.future.done():
+                it.future.set_exception(
+                    ServerDrainingError("server drained before this request ran")
+                )
+        if leftovers:
+            FAILURE_EVENTS.record("scheduler.shed_draining", len(leftovers))
+        # The worker retires on its own when it observes DRAINING with an
+        # empty queue; the sentinel covers the race where it is mid-wait.
+        self._put(None)
+        self._worker.join(timeout=max(0.1, deadline - time.monotonic()) if not clean else 5)
+        clean = clean and not self._worker.is_alive() and not leftovers
+        with self._cv:
+            self._state = ServerState.STOPPED
+        return clean
+
     def shutdown(self) -> None:
+        """Legacy stop: post the FIFO sentinel (backlog is served first) and
+        join. Kept for back-compat; ``drain()`` is the graceful variant with
+        admission close and timeout semantics."""
         self._put(None)
         self._worker.join(timeout=5)
+        with self._cv:
+            self._state = ServerState.STOPPED

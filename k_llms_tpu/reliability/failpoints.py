@@ -9,6 +9,9 @@ process-wide via ``KLLMS_FAILPOINTS``.
 Injection sites wired in this package:
 
 - ``scheduler.admit``    — evaluated at submit time (admission control)
+- ``engine.launch``      — evaluated at the top of every coalesced batch
+                           launch, inside the OOM guard; the ``oom`` action
+                           here exercises split-and-requeue without a device
 - ``engine.decode``      — evaluated per request around the decode loop;
                            ``kill_samples`` marks a seeded subset of the n
                            samples as lost mid-decode
@@ -18,6 +21,9 @@ Injection sites wired in this package:
 Actions (``FailSpec.action``):
 
 - ``"raise"``        — raise ``error_factory()`` (default RuntimeError)
+- ``"oom"``          — raise a RESOURCE_EXHAUSTED-shaped RuntimeError matching
+                       what jax surfaces on device HBM exhaustion, so the
+                       engine's OOM guard (not generic error handling) catches
 - ``"sleep"``        — block ``delay`` seconds (deadline-expiry simulation)
 - ``"kill_samples"`` — no-op at the site itself; the engine reads ``kill`` and
                        ``seed`` and marks that many samples failed
@@ -28,7 +34,8 @@ then recovers" retry tests are scripted.
 
 Env syntax (comma-separated):
     KLLMS_FAILPOINTS="backend.dispatch=raise:2,engine.decode=kill_samples:3:7"
-where the first numeric arg is ``times`` for raise/sleep specs and
+    KLLMS_FAILPOINTS="engine.launch=oom:1"
+where the first numeric arg is ``times`` for raise/sleep/oom specs and
 ``kill[:seed]`` for kill_samples.
 """
 
@@ -47,15 +54,26 @@ logger = logging.getLogger(__name__)
 
 SITES = (
     "scheduler.admit",
+    "engine.launch",
     "engine.decode",
     "backend.dispatch",
     "consensus.consolidate",
 )
 
 
+def _injected_oom() -> BaseException:
+    # Mirrors the message jaxlib's XlaRuntimeError carries on HBM exhaustion;
+    # the engine's OOM guard matches on the RESOURCE_EXHAUSTED marker, so the
+    # injected fault takes exactly the split-and-requeue path a real one would.
+    return RuntimeError(
+        "RESOURCE_EXHAUSTED: injected device OOM (failpoint): "
+        "Out of memory while trying to allocate batch buffers"
+    )
+
+
 @dataclass
 class FailSpec:
-    action: str = "raise"  # "raise" | "sleep" | "kill_samples"
+    action: str = "raise"  # "raise" | "oom" | "sleep" | "kill_samples"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
     )
@@ -66,7 +84,7 @@ class FailSpec:
     _fired: int = field(default=0, init=False, repr=False)
 
     def __post_init__(self) -> None:
-        if self.action not in ("raise", "sleep", "kill_samples"):
+        if self.action not in ("raise", "oom", "sleep", "kill_samples"):
             raise ValueError(f"unknown failpoint action {self.action!r}")
 
 
@@ -95,6 +113,8 @@ def fire(site: str) -> Optional[FailSpec]:
     logger.debug("failpoint %s fired (%s)", site, spec.action)
     if spec.action == "raise":
         raise spec.error_factory()
+    if spec.action == "oom":
+        raise _injected_oom()
     if spec.action == "sleep":
         time.sleep(spec.delay)
         return None
@@ -146,6 +166,9 @@ def configure_from_env(env: Optional[str] = None) -> None:
             delay = float(args[0]) if args else 0.1
             times = int(args[1]) if len(args) > 1 else None
             specs[site] = FailSpec(action="sleep", delay=delay, times=times)
+        elif action == "oom":
+            times = int(args[0]) if args else None
+            specs[site] = FailSpec(action="oom", times=times)
         else:
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action="raise", times=times)

@@ -45,15 +45,19 @@ from .parsed import KLLMsParsedChatCompletion
 from .wire import (
     BackendUnavailableError,
     KLLMsError,
+    RateLimitError,
     RequestCancelledError,
     RequestTimeoutError,
+    ServerDrainingError,
 )
 
 __all__ = [
     "BackendUnavailableError",
     "KLLMsError",
+    "RateLimitError",
     "RequestCancelledError",
     "RequestTimeoutError",
+    "ServerDrainingError",
     "ChatCompletion",
     "ChatCompletionMessage",
     "Choice",

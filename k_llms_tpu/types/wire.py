@@ -186,3 +186,30 @@ class BackendUnavailableError(KLLMsError):
     type = "server_error"
     code = "backend_unavailable"
     status_code = 503
+
+
+class RateLimitError(KLLMsError):
+    """Admission shed: the scheduler's queue is at its weight cap and this
+    request was rejected instead of queued unboundedly (openai.RateLimitError's
+    wire shape). ``retry_after`` is the scheduler's estimate, in seconds, of
+    when the queue will have drained enough to admit work of this weight —
+    serving frontends map it to the HTTP ``Retry-After`` header (OpenAI carries
+    it as a header, not in the error body, so ``as_wire`` stays unchanged)."""
+
+    type = "rate_limit_error"
+    code = "rate_limit_exceeded"
+    status_code = 429
+
+    def __init__(self, message: str, retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class ServerDrainingError(KLLMsError):
+    """The serving process is draining (or has stopped): admission is closed
+    while in-flight work finishes. A load balancer should retry the request
+    against another replica — hence 503, the standard drain signal."""
+
+    type = "server_error"
+    code = "server_draining"
+    status_code = 503

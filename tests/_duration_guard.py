@@ -1,0 +1,58 @@
+"""Collection-time duration-budget guard (ISSUE 2 satellite).
+
+Heavy tests declare their expected runtime with
+``@pytest.mark.duration_budget(seconds)``.  Any test whose declared budget
+exceeds ``TIER1_BUDGET_SECONDS`` must also be tagged ``slow`` — otherwise it
+silently eats the tier-1 (``-m 'not slow'``) 870 s timeout (ROADMAP.md).  The
+check runs at COLLECTION time so the violation fails the run immediately and
+deterministically instead of surfacing as a flaky timeout twenty minutes in.
+
+Kept as a plain module (not conftest-inline) so the rule itself is unit-tested
+in ``tests/test_overload.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+# A single tier-1 test declaring more than this many seconds must be `slow`.
+TIER1_BUDGET_SECONDS = 30.0
+
+
+def declared_budget(item) -> Optional[float]:
+    """The test's declared duration budget in seconds, or None."""
+    m = item.get_closest_marker("duration_budget")
+    if m is None:
+        return None
+    if not m.args:
+        raise ValueError(
+            f"{item.nodeid}: duration_budget marker needs a seconds argument, "
+            "e.g. @pytest.mark.duration_budget(45)"
+        )
+    return float(m.args[0])
+
+
+def check_items(items, threshold: float = TIER1_BUDGET_SECONDS) -> List[Tuple[str, float]]:
+    """Return (nodeid, budget) for every item whose declared budget exceeds
+    ``threshold`` without a ``slow`` tag.  Empty list = collection may proceed."""
+    violations: List[Tuple[str, float]] = []
+    for item in items:
+        budget = declared_budget(item)
+        if budget is None:
+            continue
+        if budget > threshold and item.get_closest_marker("slow") is None:
+            violations.append((item.nodeid, budget))
+    return violations
+
+
+def enforce(items, threshold: float = TIER1_BUDGET_SECONDS) -> None:
+    """Raise ``pytest.UsageError`` (fails collection) on any violation."""
+    violations = check_items(items, threshold)
+    if violations:
+        import pytest
+
+        lines = "\n".join(f"  {nodeid} declares {budget:g}s" for nodeid, budget in violations)
+        raise pytest.UsageError(
+            f"test(s) declare a duration budget over {threshold:g}s without a "
+            f"`slow` tag — tag them @pytest.mark.slow or shrink them:\n{lines}"
+        )

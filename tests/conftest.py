@@ -31,6 +31,12 @@ def pytest_configure(config):
         "mesh: requires the 8-device virtual CPU mesh (conftest sets it up; "
         "a caller-preset XLA_FLAGS without the device-count flag breaks it)",
     )
+    config.addinivalue_line(
+        "markers",
+        "duration_budget(seconds): declared expected runtime; budgets over "
+        "30s require the `slow` tag (enforced at collection by "
+        "tests/_duration_guard.py)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -48,3 +54,10 @@ def pytest_collection_modifyitems(config, items):
             if "8-device CPU mesh" in reason or "mesh" in reason.lower():
                 item.add_marker(pytest.mark.mesh)
                 break
+
+    # Duration-budget guard: a test declaring a budget over the tier-1
+    # threshold without a `slow` tag fails COLLECTION (deterministic, instant)
+    # instead of flaking the 870 s tier-1 timeout at runtime.
+    from _duration_guard import enforce
+
+    enforce(items)

@@ -74,6 +74,23 @@ PARITY_VECTORS: list[tuple[str, str]] = [
     ("行", "Xing "),
     ("了不起", "Liao Bu Qi "),
     ("重行", "Zhong Xing "),
+    # Common toponyms/institution words the round-5 probe found missing from
+    # the frequency table (not rare-tail: 中華/經濟/歷史/廣州 are everyday
+    # vocabulary), traditional and simplified forms both.
+    ("華", "Hua "),
+    ("中華", "Zhong Hua "),
+    ("中华", "Zhong Hua "),
+    ("經濟", "Jing Ji "),
+    ("经济", "Jing Ji "),
+    ("歷史", "Li Shi "),
+    ("历史", "Li Shi "),
+    ("廣州", "Guang Zhou "),
+    ("广州", "Guang Zhou "),
+    ("深圳", "Shen Zhen "),
+    ("大阪", "Da Ban "),
+    ("株式会社", "Zhu Shi Hui She "),
+    ("關係", "Guan Xi "),
+    ("中華人民共和国", "Zhong Hua Ren Min Gong He Guo "),
     # Kana (lowercase romaji, no separators; unidecode's famous quirks kept:
     # は stays "ha" even as a particle, small っ is "tsu", ー is "-")
     ("こんにちは", "konnichiha"),
@@ -91,24 +108,45 @@ PARITY_VECTORS: list[tuple[str, str]] = [
     ("\u1109\u1165\u110b\u116e\u11af", "seoul"),
 ]
 
-# (input, real unidecode output, our transliterate output = per-codepoint
-# tokens).  Long-tail ideographs outside the frequency table: real unidecode
-# carries full Unihan tables and still romanizes these; we keep them distinct
-# via u<hex> tokens instead.
+# Long-tail ideographs outside the frequency table: real unidecode carries
+# full Unihan tables and still romanizes these; we keep them distinct via
+# u<hex> tokens instead.  Entries are (input, real unidecode output, our
+# transliterate output = per-codepoint tokens).
 #
-# Provenance: the "real" outputs below are hand-encoded from unidecode 1.3.8's
-# published data tables (x09e.py / x07f.py), NOT verified against an installed
-# wheel in this image.  Tests only assert got != real (documented divergence),
-# so a wrong hand-encoded value here cannot fail a test — if you bump the
-# pinned version or gain access to the wheel, re-verify these two entries.
-DIVERGENT_VECTORS: list[tuple[str, str, str]] = [
-    (inp, real, "".join(f"u{ord(c):04x}" for c in inp))
-    for inp, real in [
+# Provenance (ADVICE.md #3): the divergence test's ``got != real`` assertion
+# can never fail on a WRONG "real" pin, so a pin only belongs in
+# ``DIVERGENT_VECTORS`` once verified against an installed unidecode wheel
+# (pinned version: 1.3.8).  This image does not ship the wheel
+# (``import unidecode`` raises ModuleNotFoundError), so the two hand-encoded
+# entries — transcribed from unidecode 1.3.8's published data tables
+# (x09e.py / x07f.py) but never checked against a running wheel — live in
+# ``UNVERIFIED_DIVERGENT_VECTORS``.  Their "real" values are documentation,
+# NOT oracle data: they are excluded from ``UNIDECODE_TABLE`` so an incorrect
+# transcription can't leak into reference-parity tests as ground truth.
+# ``tests/test_translit.py::test_pins_match_installed_unidecode_wheel`` runs
+# whenever the wheel IS importable and promotes/corrects these automatically
+# flagging any drift; until then only the ``got == ours`` half is asserted.
+UNIDECODE_PINNED_VERSION = "1.3.8"
+
+_DIVERGENT = lambda pairs: [  # noqa: E731 - tiny local helper
+    (inp, real, "".join(f"u{ord(c):04x}" for c in inp)) for inp, real in pairs
+]
+
+# Wheel-verified divergent pins (empty until a wheel is available to verify
+# against; see provenance note above).
+DIVERGENT_VECTORS: list[tuple[str, str, str]] = _DIVERGENT([])
+
+# Hand-encoded, explicitly UNVERIFIED divergent pins.
+UNVERIFIED_DIVERGENT_VECTORS: list[tuple[str, str, str]] = _DIVERGENT(
+    [
         ("麤", "Cu "),   # U+9EA4 'coarse' (triple deer) — rare tail
         ("羴", "Shan "),  # U+7FB4 'rank odor of sheep' — rare tail
     ]
-]
+)
 
+# The reference-oracle stub table is built ONLY from parity vectors and
+# wheel-verified divergent pins — unverified "real" values must not become
+# the oracle's ground truth.
 UNIDECODE_TABLE: dict[str, str] = {}
 for _inp, _out in PARITY_VECTORS + [(i, r) for i, r, _ in DIVERGENT_VECTORS]:
     UNIDECODE_TABLE[_inp] = _out

@@ -270,6 +270,8 @@ def test_batch_window_skipped_when_budget_exhausted():
     sched.shutdown()
 
 
+@pytest.mark.slow  # 8s concurrency e2e; per-request spec_stats plumbing is
+@pytest.mark.duration_budget(45)  # also covered by test_speculative
 def test_concurrent_traced_requests_keep_their_own_spec_stats(monkeypatch):
     """Two concurrent traced requests must each carry their OWN generation-time
     engine stats even though they share one engine (the regression the

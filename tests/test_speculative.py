@@ -199,6 +199,8 @@ def test_spec_composes_top_logprobs(engines):
         )
 
 
+@pytest.mark.slow  # 9s e2e composition; spec decode and the JSON DFA each
+@pytest.mark.duration_budget(45)  # have dedicated tier-1 coverage
 def test_spec_composes_json_constraint(engines):
     """The grammar automaton advances across accepted drafts: greedy
     constrained output matches the normal constrained loop exactly, and every

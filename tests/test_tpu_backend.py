@@ -62,6 +62,8 @@ def test_logprobs_surface(client):
     assert sample.logprobs.content[0].logprob <= 0.0
 
 
+@pytest.mark.slow  # 17s e2e spanning embeddings + llm-consensus; each half
+@pytest.mark.duration_budget(45)  # has dedicated tier-1 coverage
 def test_backend_embeddings_and_llm_consensus():
     backend = TpuBackend(model="tiny", max_new_tokens=8)
     embs = backend.embeddings(["alpha beta", "alpha beta", "gamma"])

@@ -9,7 +9,12 @@ long-tail ideographs.
 
 import pytest
 
-from fixtures.unidecode_vectors import DIVERGENT_VECTORS, PARITY_VECTORS
+from fixtures.unidecode_vectors import (
+    DIVERGENT_VECTORS,
+    PARITY_VECTORS,
+    UNIDECODE_PINNED_VERSION,
+    UNVERIFIED_DIVERGENT_VECTORS,
+)
 from k_llms_tpu.consensus.settings import ConsensusSettings
 from k_llms_tpu.consensus.text import ascii_fold, sanitize_value
 from k_llms_tpu.consensus.translit import transliterate
@@ -21,13 +26,44 @@ def test_parity_with_real_unidecode(inp, expected):
     assert transliterate(inp) == expected
 
 
-@pytest.mark.parametrize("inp,real,ours", DIVERGENT_VECTORS, ids=[v[0] for v in DIVERGENT_VECTORS])
+_ALL_DIVERGENT = DIVERGENT_VECTORS + UNVERIFIED_DIVERGENT_VECTORS
+
+
+@pytest.mark.parametrize(
+    "inp,real,ours", _ALL_DIVERGENT, ids=[v[0] for v in _ALL_DIVERGENT]
+)
 def test_documented_long_tail_divergence(inp, real, ours):
     # real unidecode romanizes even rare tail ideographs (full Unihan tables);
-    # we emit per-codepoint tokens for them (distinctness only)
+    # we emit per-codepoint tokens for them (distinctness only).  The strong
+    # claim is ``got == ours`` (exact per-codepoint token form); ``got != real``
+    # is asserted only for wheel-VERIFIED pins — on unverified ones it could
+    # never fail against a wrong pin (ADVICE.md #3), so it proves nothing.
     got = transliterate(inp)
     assert got == ours
-    assert got != real  # the divergence is intentional and documented
+    if (inp, real, ours) in DIVERGENT_VECTORS:
+        assert got != real  # the divergence is intentional and documented
+
+
+def test_pins_match_installed_unidecode_wheel():
+    """Verify every hand-encoded "real unidecode" pin against the actual
+    wheel.  The CI image doesn't ship unidecode, so this skips there — but any
+    environment that has it (a dev box, a future image bump) validates the
+    whole fixture and flags entries that can be promoted out of
+    UNVERIFIED_DIVERGENT_VECTORS."""
+    unidecode = pytest.importorskip("unidecode")
+    version = getattr(unidecode, "__version__", None) or pytest.importorskip(
+        "importlib.metadata"
+    ).version("Unidecode")
+    assert version == UNIDECODE_PINNED_VERSION, (
+        f"installed unidecode {version} != pinned {UNIDECODE_PINNED_VERSION}; "
+        "re-verify the fixture vectors before bumping the pin"
+    )
+    for inp, real in PARITY_VECTORS:
+        assert unidecode.unidecode(inp) == real, f"parity pin wrong for {inp!r}"
+    for inp, real, _ in _ALL_DIVERGENT:
+        assert unidecode.unidecode(inp) == real, (
+            f"divergent 'real' pin wrong for {inp!r}"
+        )
 
 
 def test_cjk_vote_keys_match_reference_pipeline():
