@@ -46,18 +46,15 @@ def _visible_token_count(tok, ids: List[int], pos: int, text: str) -> int:
     completion-sized, so the linear scan is cheap.
     """
     visible = text[:pos]
-    # Decoded length is NON-DECREASING in the token count (a token's bytes add
-    # >= 0 chars), so binary search gives the first k whose decode merely
-    # REACHES pos — a valid lower bound that makes the text-comparison scan
-    # O(log T) + the few boundary tokens instead of O(T^2) re-decodes.
-    lo, hi = 0, len(ids)
-    while lo < hi:
-        mid = (lo + hi) // 2
-        if len(tok.decode(ids[:mid])) >= pos:
-            hi = mid
-        else:
-            lo = mid + 1
-    for k in range(lo, len(ids) + 1):
+    # Decoded length is USUALLY non-decreasing in the token count, which made
+    # binary search look like a valid lower bound — but HF-style decode
+    # cleanup (e.g. clean_up_tokenization_spaces collapsing " ," to ",") can
+    # SHRINK the decode when a token is appended, so bisection may skip the
+    # true boundary and a scan started from its result silently over-bills
+    # (or, finding nothing, falls through to len(ids)). The front scan is the
+    # only predicate correct under arbitrary decode post-processing, and ids
+    # are completion-sized, so it stays cheap.
+    for k in range(len(ids) + 1):
         prefix = tok.decode(ids[:k])
         if len(prefix) >= pos and prefix[:pos] == visible:
             return k
@@ -328,6 +325,7 @@ class TpuBackend(Backend):
         # clean launch (width steps back up, DEGRADED clears).
         self.engine.on_oom = self.scheduler.note_oom
         self.engine.on_launch_ok = self.scheduler.note_recovered
+        self.engine.on_spec_stats = self.scheduler.note_spec_stats
         self._closed = False
         self._dfa_cache: Dict[str, Any] = {}
 

@@ -52,7 +52,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, RateLimitError, ServerDrainingError
-from ..utils.observability import FAILURE_EVENTS
+from ..utils.observability import FAILURE_EVENTS, SPEC_EVENTS
 
 logger = logging.getLogger(__name__)
 
@@ -160,6 +160,12 @@ class EngineScheduler:
         self._shed_over_capacity = 0
         self._evicted = 0
         self._oom_splits = 0
+        # Speculative-decoding aggregates (engine.on_spec_stats): per-launch
+        # drafted/accepted counts plus the most recent acceptance rate.
+        self._spec_launches = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_tpi_last: Optional[float] = None
         self._queue_weight = 0
         self._in_flight = 0
         self._state = ServerState.STARTING
@@ -212,6 +218,25 @@ class EngineScheduler:
                 self._ok_since_backoff = 0
                 if self._width_shift == 0 and self._state is ServerState.DEGRADED:
                     self._state = ServerState.READY
+
+    def note_spec_stats(self, stats: Dict[str, Any]) -> None:
+        """One speculative launch completed (engine.on_spec_stats hook):
+        fold its drafted/accepted accounting into the serving-path aggregates
+        and the process-wide observability counters."""
+        drafted = int(stats.get("drafted") or 0)
+        accepted = int(stats.get("accepted") or 0)
+        tpi = stats.get("tokens_per_iteration")
+        with self._cv:
+            self._spec_launches += 1
+            self._spec_drafted += drafted
+            self._spec_accepted += accepted
+            if tpi is not None:
+                self._spec_tpi_last = float(tpi)
+        SPEC_EVENTS.record("spec.launches")
+        if drafted:
+            SPEC_EVENTS.record("spec.drafted", drafted)
+        if accepted:
+            SPEC_EVENTS.record("spec.accepted", accepted)
 
     # -- worker -----------------------------------------------------------
     def _next_group(self) -> Optional[List[_Item]]:
@@ -603,6 +628,10 @@ class EngineScheduler:
                 "batches": self._batches,
                 "coalesced": self._coalesced,
                 "shed": self._shed,
+                "spec_launches": self._spec_launches,
+                "spec_drafted": self._spec_drafted,
+                "spec_accepted": self._spec_accepted,
+                "spec_tokens_per_iteration": self._spec_tpi_last,
             }
 
     def health(self) -> Dict[str, Any]:

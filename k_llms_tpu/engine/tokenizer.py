@@ -102,6 +102,12 @@ class HFTokenizer:
         bd = self._byte_decoder
         if bd and all(c in bd for c in tok_str):
             return bytes(bd[c] for c in tok_str)
+        # SentencePiece fallback: the raw piece carries '▁' (U+2581)
+        # word-boundary markers where the text has spaces. decode([id]) strips
+        # a leading space from a lone piece, so concatenating per-token bytes
+        # would drop every inter-word space — map the marker directly instead.
+        if "▁" in tok_str:
+            return tok_str.replace("▁", " ").encode("utf-8")
         return self.decode([token_id]).encode("utf-8")
 
     def apply_chat_template(

@@ -368,14 +368,14 @@ def _block(
 
     def _merge_prefix_tail(out_p, m_p, l_p):
         """Exact logsumexp merge of a prefix-phase partial (normalized out,
-        running max m, denominator l — each [B, QH]-leading) with the per-row
-        generated-KV tail computed in XLA."""
-        s_g = _gqa_scores(q, cache_k) * scale  # [B, QH, 1, G]
+        running max m, denominator l — each [B, QH, Sq]-leading; single-query
+        callers pass Sq=1) with the per-row generated-KV tail computed in XLA."""
+        s_g = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, G]
         s_g = jnp.where(key_mask[:, None, :, :], s_g, jnp.finfo(jnp.float32).min)
-        m_g = jnp.max(s_g, axis=-1)[:, :, 0]  # [B, QH]
-        p_g = jnp.exp(s_g - m_g[:, :, None, None])
-        l_g = jnp.sum(p_g, axis=-1)[:, :, 0]
-        out_g = _gqa_values(p_g, cache_v)[:, 0]  # [B, QH, D], sum of p*v
+        m_g = jnp.max(s_g, axis=-1)  # [B, QH, Sq]
+        p_g = jnp.exp(s_g - m_g[..., None])
+        l_g = jnp.sum(p_g, axis=-1)  # [B, QH, Sq]
+        out_g = _gqa_values(p_g, cache_v).transpose(0, 2, 1, 3)  # [B, QH, Sq, D]
 
         m = jnp.maximum(m_p, m_g)
         a_p = jnp.exp(m_p - m)
@@ -384,32 +384,45 @@ def _block(
         merged = (
             out_p * (l_p * a_p)[..., None] + out_g * a_g[..., None]
         ) / jnp.where(denom == 0.0, 1.0, denom)[..., None]
-        attn = merged[:, None]  # [B, Sq=1, QH, D]
+        attn = merged.transpose(0, 2, 1, 3)  # [B, Sq, QH, D]
         return attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
 
-    # Decode step against a SEQUENCE-SHARDED prefix (ring decode): the SP
-    # prefill left its KV sharded over the mesh's data axis; chunks rotate the
-    # ring with online-softmax accumulation, so the prefix is never gathered
-    # and long-context serving stays O(S/P) end-to-end.
+    # Decode/verify step against a SEQUENCE-SHARDED prefix (ring attention):
+    # the SP prefill left its KV sharded over the mesh's data axis; chunks
+    # rotate the ring with online-softmax accumulation, so the prefix is never
+    # gathered and long-context serving stays O(S/P) end-to-end. Sq == 1 is
+    # the plain decode step; Sq > 1 is a speculative VERIFY block scoring the
+    # whole draft window in one ring pass (all verify queries sit past the
+    # prompt, so prefix visibility is non-causal and the same valid-column
+    # masking applies).
     if (
         sp_ring_mesh is not None
         and write_index is not None
-        and Sq == 1
         and prefix_kv is not None
         and prefix_lengths is not None
         and config.attn_softcap is None
         and config.sliding_window is None
     ):
-        from ..ops.ring_attention import ring_decode_prefix
+        from ..ops.ring_attention import ring_decode_prefix, ring_verify_prefix
 
-        out_p, m_p, l_p = ring_decode_prefix(
-            sp_ring_mesh,
-            q[:, 0],
-            prefix_kv[0],
-            prefix_kv[1],
-            prefix_lengths.reshape(-1)[0],  # ring path is single-request (R=1)
-            sm_scale=scale,
-        )
+        plen = prefix_lengths.reshape(-1)[0]  # ring path is single-request (R=1)
+        if Sq == 1:
+            out_p, m_p, l_p = ring_decode_prefix(
+                sp_ring_mesh, q[:, 0], prefix_kv[0], prefix_kv[1], plen,
+                sm_scale=scale,
+            )
+            out_p = out_p[:, :, None]  # [B, QH, 1, D]
+            m_p = m_p[:, :, None]
+            l_p = l_p[:, :, None]
+        else:
+            out_p, m_p, l_p = ring_verify_prefix(
+                sp_ring_mesh,
+                q.transpose(0, 2, 1, 3),  # [B, QH, Sq, D]
+                prefix_kv[0],
+                prefix_kv[1],
+                plen,
+                sm_scale=scale,
+            )
         return mlp(attn_out(_merge_prefix_tail(out_p, m_p, l_p))), (cache_k, cache_v)
 
     # Decode step against a shared prefix: the Pallas decode kernel streams
@@ -438,7 +451,10 @@ def _block(
             sm_scale=scale,
             interpret=jax.default_backend() != "tpu",
         )
-        return mlp(attn_out(_merge_prefix_tail(out_p, m_p, l_p))), (cache_k, cache_v)
+        return (
+            mlp(attn_out(_merge_prefix_tail(out_p[:, :, None], m_p[:, :, None], l_p[:, :, None]))),
+            (cache_k, cache_v),
+        )
 
     scores = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, Smax] f32
     if config.attn_softcap is not None:
@@ -788,6 +804,7 @@ def verify_step(
     prompt_len: jax.Array,
     gen_cache: KVCache,
     prefix: KVCache,
+    sp_ring_mesh=None,
 ) -> Tuple[jax.Array, KVCache]:
     """Speculative-decoding verification: score k+1 tokens per row in ONE
     forward (the draft-tree trunk of prompt-lookup decoding).
@@ -796,7 +813,10 @@ def verify_step(
     lengths: [B] per-row generated-token counts (the write offset into the
     row's gen cache slots); prompt_len: scalar or [R] as in decode_step.
     KVs for all Sq positions are written at per-row offsets; acceptance-
-    rejected slots simply get overwritten by a later verify. Returns
+    rejected slots simply get overwritten by a later verify.
+    ``sp_ring_mesh``: as in :func:`decode_step` — the prefix KV is
+    sequence-sharded over the mesh's data axis and each block verifies the
+    draft window against it via ring attention. Returns
     (logits f32 [B, Sq, V] — logits[b, j] conditions on tokens[b, :j+1] —
     and the updated gen_cache).
     """
@@ -840,6 +860,7 @@ def verify_step(
         key_mask_global=self_mask_global,
         prefix_mask_global=prefix_mask_global,
         prefix_lengths=pl,
+        sp_ring_mesh=sp_ring_mesh,
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     logits = _logits(config, params, h)

@@ -29,10 +29,12 @@ def _to_checkpoint_tree(tree: Any) -> Any:
     serialized — the engine re-marks after load."""
     from .quant import Q4Tensor, QTensor
 
+    # 0-d ndarray, not np.int32 scalar: StandardCheckpointer's type check
+    # accepts arrays only (numpy scalars fail save on current orbax).
     if isinstance(tree, Q4Tensor):
-        return {"q": tree.q, "scale": tree.scale, "fmt": np.int32(4)}
+        return {"q": tree.q, "scale": tree.scale, "fmt": np.array(4, np.int32)}
     if isinstance(tree, QTensor):
-        return {"q": tree.q, "scale": tree.scale, "fmt": np.int32(8)}
+        return {"q": tree.q, "scale": tree.scale, "fmt": np.array(8, np.int32)}
     if isinstance(tree, dict):
         return {k: _to_checkpoint_tree(v) for k, v in tree.items()}
     return tree

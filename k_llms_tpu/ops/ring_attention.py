@@ -26,6 +26,20 @@ from jax.sharding import PartitionSpec as P
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
+# jax moved shard_map out of experimental (and introduced explicit
+# varying-axis typing via lax.pvary) after 0.4.x; support both so the ring
+# paths run on the 0.4-series CPU image as well as current TPU toolchains.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # 0.4.x: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if hasattr(lax, "pvary"):
+    _pvary = lax.pvary
+else:  # 0.4.x infers replication instead of explicit varying-axis marks
+    def _pvary(x, axes):
+        return x
+
 
 def _chunk_attention_update(q, k, v, q_pos, k_pos, causal, scale, acc, m, l):
     """One online-softmax accumulation step against a K/V chunk.
@@ -78,9 +92,9 @@ def ring_attention_local(
     # pvary: the accumulators start identical on every device but become
     # device-varying inside the loop; shard_map's axis typing requires the
     # carry to be marked varying up front.
-    acc0 = lax.pvary(jnp.zeros((B, QH, S_local, D), jnp.float32), (axis_name,))
-    m0 = lax.pvary(jnp.full((B, QH, S_local, 1), NEG_INF, jnp.float32), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((B, QH, S_local, 1), jnp.float32), (axis_name,))
+    acc0 = _pvary(jnp.zeros((B, QH, S_local, D), jnp.float32), (axis_name,))
+    m0 = _pvary(jnp.full((B, QH, S_local, 1), NEG_INF, jnp.float32), (axis_name,))
+    l0 = _pvary(jnp.zeros((B, QH, S_local, 1), jnp.float32), (axis_name,))
 
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
@@ -139,9 +153,9 @@ def ring_decode_prefix(
         # Accumulators become varying over every axis the inputs vary on
         # (sequence ring + model-sharded heads), so mark them up front.
         vary = tuple(a for a in (seq_axis, model_axis) if a in mesh.axis_names)
-        acc0 = lax.pvary(jnp.zeros((B_local, QH, D), jnp.float32), vary)
-        m0 = lax.pvary(jnp.full((B_local, QH), NEG_INF, jnp.float32), vary)
-        l0 = lax.pvary(jnp.zeros((B_local, QH), jnp.float32), vary)
+        acc0 = _pvary(jnp.zeros((B_local, QH, D), jnp.float32), vary)
+        m0 = _pvary(jnp.full((B_local, QH), NEG_INF, jnp.float32), vary)
+        l0 = _pvary(jnp.zeros((B_local, QH), jnp.float32), vary)
 
         perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
@@ -181,7 +195,98 @@ def ring_decode_prefix(
     q_spec = P(seq_axis, model_axis, None)
     kv_spec = P(None, seq_axis, model_axis, None)
     out_spec = (q_spec, P(seq_axis, model_axis), P(seq_axis, model_axis))
-    return jax.shard_map(
+    return _shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P()),
+        out_specs=out_spec,
+    )(q, prefix_k, prefix_v, prefix_len)
+
+
+def ring_verify_prefix(
+    mesh: Mesh,
+    q: jax.Array,
+    prefix_k: jax.Array,
+    prefix_v: jax.Array,
+    prefix_len: jax.Array,
+    *,
+    seq_axis: str = "data",
+    model_axis: str = "model",
+    sm_scale: Optional[float] = None,
+):
+    """Multi-query sibling of :func:`ring_decode_prefix` for speculative
+    VERIFY steps: score a whole draft block (Sq = lookahead + 1 queries per
+    row) against the sequence-sharded prefix in one ring pass, so spec decode
+    composes with sp_decode instead of falling back to the normal loop.
+
+    Every verify query sits past the prompt, so the prefix phase is
+    NON-CAUSAL — all Sq queries see exactly the ``prefix_len`` valid keys,
+    which is the same per-chunk valid-column mask the decode op uses; the ring
+    structure is otherwise identical (K/V chunks rotate, queries stay put,
+    online-softmax accumulation, still P-1 hops per verify rather than per
+    token — the whole point of verifying blocks).
+
+    q: [B, QH, Sq, D] with B sharded over ``seq_axis`` and QH over
+    ``model_axis``; prefix_k/v: [1, S, KVH, D] with S over ``seq_axis``;
+    prefix_len: scalar valid key count. Returns (out [B, QH, Sq, D] f32 —
+    normalized within the prefix phase, m [B, QH, Sq], l [B, QH, Sq]) for the
+    caller's exact logsumexp merge with the generated-KV tail.
+    """
+
+    def local(q, pk, pv, plen):
+        B_local, QH, Sq, D = q.shape
+        S_local = pk.shape[1]
+        KVH = pk.shape[2]
+        G = QH // KVH
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+        p_size = lax.psum(1, seq_axis)
+        my_idx = lax.axis_index(seq_axis)
+
+        qg = q.astype(jnp.float32).reshape(B_local, KVH, G, Sq, D)
+        vary = tuple(a for a in (seq_axis, model_axis) if a in mesh.axis_names)
+        acc0 = _pvary(jnp.zeros((B_local, QH, Sq, D), jnp.float32), vary)
+        m0 = _pvary(jnp.full((B_local, QH, Sq), NEG_INF, jnp.float32), vary)
+        l0 = _pvary(jnp.zeros((B_local, QH, Sq), jnp.float32), vary)
+
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+        def step(i, carry):
+            acc, m, l, k_cur, v_cur = carry
+            src = (my_idx - i) % p_size
+            cols = src * S_local + jnp.arange(S_local)
+            valid = cols < plen  # [S_local]
+            # [B, KVH, G, Sq, D] x [S, KVH, D] -> [B, KVH, G, Sq, S]
+            s = jnp.einsum(
+                "bhgqd,shd->bhgqs", qg, k_cur[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(valid[None, None, None, None, :], s, NEG_INF)
+            s = s.reshape(B_local, QH, Sq, S_local)
+
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_cur)
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            delta = jnp.einsum(
+                "bhgqs,shd->bhgqd",
+                p.reshape(B_local, KVH, G, Sq, S_local),
+                v_cur[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).reshape(B_local, QH, Sq, D)
+            acc_new = acc * alpha[..., None] + delta
+            k_nxt = lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = lax.ppermute(v_cur, seq_axis, perm)
+            return (acc_new, m_new, l_new, k_nxt, v_nxt)
+
+        acc, m, l, _, _ = lax.fori_loop(0, p_size, step, (acc0, m0, l0, pk, pv))
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        return acc / safe_l[..., None], m, l
+
+    q_spec = P(seq_axis, model_axis, None, None)
+    kv_spec = P(None, seq_axis, model_axis, None)
+    out_spec = (q_spec, P(seq_axis, model_axis, None), P(seq_axis, model_axis, None))
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, P()),
@@ -206,7 +311,7 @@ def ring_attention(
     fn = functools.partial(
         ring_attention_local, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
     )
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         lambda q, k, v: fn(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -280,7 +385,7 @@ def suffix_prefix_attention(
 
     q_spec = P(None, model_axis, None, None)
     kv_spec = P(None, seq_axis, model_axis, None)
-    return jax.shard_map(
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, P()),
@@ -315,7 +420,7 @@ def scatter_into_ring(
 
     spec = P(None, seq_axis, model_axis, None)
     rep = P(None, None, model_axis, None)
-    return jax.shard_map(
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, rep, P(), P()),

@@ -93,6 +93,12 @@ class EventCounters:
 #: cancellations), and the engine (decode aborts, killed samples).
 FAILURE_EVENTS = EventCounters()
 
+#: Process-wide speculative-decoding counters (spec.launches, spec.drafted,
+#: spec.accepted), fed by EngineScheduler.note_spec_stats from the engine's
+#: per-launch on_spec_stats hook. spec.accepted / spec.drafted is the
+#: fleet-level acceptance rate operators tune spec_lookahead against.
+SPEC_EVENTS = EventCounters()
+
 
 def _walk_confidences(node: Any, out: List[float]) -> None:
     if isinstance(node, dict):

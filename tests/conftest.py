@@ -25,6 +25,65 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# -- shared engines ----------------------------------------------------------
+# Engine construction dominates suite wall time: every LocalEngine owns its
+# own jit caches, so two module fixtures building "the same" engine compile
+# every prefill/decode program twice. This session-scoped factory hands out
+# ONE engine per construction key — identical engines across test_w4 /
+# test_sp_decode / test_tpu_backend / test_speculative share compiles.
+#
+# Engines are STATEFUL (prefix cache, spec_stats, jit caches): tests that
+# assert on those counters must reset them or build a private engine.
+_PARAMS_CACHE = {}
+_ENGINE_CACHE = {}
+
+
+def shared_params(config, param_key=0):
+    """init_params once per (config, seed) — configs are hashable."""
+    key = (config, param_key)
+    params = _PARAMS_CACHE.get(key)
+    if params is None:
+        from k_llms_tpu.models import init_params
+
+        params = init_params(config, jax.random.key(param_key))
+        _PARAMS_CACHE[key] = params
+    return params
+
+
+def shared_engine(model="tiny", *, param_key=0, mesh_shape=None, **kwargs):
+    """One LocalEngine per (model-or-config, params seed, mesh shape, engine
+    knobs) for the whole session. ``model``: registered name or ModelConfig;
+    ``mesh_shape``: (data, model) for make_mesh, None = use_mesh=False.
+    Extra kwargs go to LocalEngine verbatim (and join the cache key)."""
+    from k_llms_tpu.models import get_config
+
+    config = get_config(model) if isinstance(model, str) else model
+    key = (config, param_key, mesh_shape, tuple(sorted(kwargs.items())))
+    eng = _ENGINE_CACHE.get(key)
+    if eng is None:
+        from k_llms_tpu.engine.engine import LocalEngine
+
+        # Always hand the engine the shared full-precision tree (it quantizes
+        # passed-in params itself): a meshed engine's own param_seed init is
+        # sharded and draws DIFFERENT values than the host-side init, which
+        # would break solo-vs-mesh bit-equality tests.
+        params = shared_params(config, param_key)
+        if mesh_shape is None:
+            eng = LocalEngine(
+                config, params=params, use_mesh=False, param_seed=param_key,
+                **kwargs,
+            )
+        else:
+            from k_llms_tpu.parallel.mesh import make_mesh
+
+            eng = LocalEngine(
+                config, params=params, mesh=make_mesh(*mesh_shape),
+                param_seed=param_key, **kwargs,
+            )
+        _ENGINE_CACHE[key] = eng
+    return eng
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -63,6 +63,21 @@ def test_below_reuse_threshold_takes_full_prefill():
     assert cached.prefix_cache_stats["partial_hits"] == 0
 
 
+def test_growing_chain_hit_accounting():
+    """The bench protocol's accounting, pinned: a growing prompt chain costs
+    one miss then partial hits only; exact repeats of the longest prompt are
+    full hits (zero prefill device work)."""
+    _, cached = _engines()
+    base = SYSTEM + DOC_A
+    chain = [base, base + DOC_B, base + DOC_B + DOC_A]
+    for p in chain:
+        cached.generate(p, n=1, max_new_tokens=2, temperature=0.0, seed=1)
+    assert cached.prefix_cache_stats == {"hits": 0, "partial_hits": 2, "misses": 1}
+    for _ in range(2):
+        cached.generate(chain[-1], n=1, max_new_tokens=2, temperature=0.0, seed=1)
+    assert cached.prefix_cache_stats == {"hits": 2, "partial_hits": 2, "misses": 1}
+
+
 def test_lru_eviction_caps_entries():
     _, cached = _engines()
     cached.prefix_cache_size = 2

@@ -215,6 +215,32 @@ def test_parse_bpe_constraint_real_checkpoint(hf_dir):
             Item.model_validate(obj)
 
 
+def test_token_bytes_sentencepiece_marker_keeps_spaces():
+    """SentencePiece vocabularies carry '▁' (U+2581) word-boundary markers in
+    the raw piece where the text has spaces. decode([id]) strips a lone
+    piece's leading space, so the old fallback dropped every inter-word space
+    from concatenated per-token bytes; the marker must map to b' ' directly."""
+
+    class FakeSP:
+        unk_token_id = 0
+
+        def convert_ids_to_tokens(self, i):
+            return {5: "▁hello", 6: "▁world", 7: "!"}.get(i)
+
+        def decode(self, ids, skip_special_tokens=True):
+            # What transformers does to a lone piece: leading space stripped.
+            return "".join(
+                self.convert_ids_to_tokens(i).replace("▁", " ") for i in ids
+            ).lstrip(" ")
+
+    tok = object.__new__(HFTokenizer)
+    tok._tok = FakeSP()
+    tok.bos_id, tok.eos_id, tok.pad_id = 1, 2, 2
+    assert tok.token_bytes(5) == b" hello"
+    joined = b"".join(tok.token_bytes(i) for i in [5, 6, 7])
+    assert joined == b" hello world!"
+
+
 def test_hf_tokenizer_without_chat_template(tmp_path, hf_dir):
     """Base-model checkpoints ship no chat template; the tokenizer falls back
     to a minimal llama-style layout instead of raising."""

@@ -317,12 +317,9 @@ def test_concurrent_traced_requests_keep_their_own_spec_stats(monkeypatch):
         spec = stats["spec"]
         # Each request's spec stats must be a VALID generation-time value for
         # that request: the spec loop's acceptance numbers (solo or
-        # coalesced), or the sp_decode fallback sentinel. A shared-state read
-        # racing another request's reset would surface as {} here.
-        assert (
-            "verify_iterations" in spec
-            or spec.get("mode") == "sp_decode_fallback"
-        ), spec
+        # coalesced). A shared-state read racing another request's reset
+        # would surface as {} here.
+        assert "verify_iterations" in spec, spec
 
 
 # -- request-lifecycle hardening: cancellation + shutdown -----------------

@@ -9,7 +9,8 @@ import numpy as np
 import pytest
 
 from k_llms_tpu.engine.engine import LocalEngine
-from k_llms_tpu.models import get_config, init_params
+from conftest import shared_engine, shared_params
+from k_llms_tpu.models import get_config
 from k_llms_tpu.ops.ring_attention import ring_decode_prefix
 from k_llms_tpu.parallel.mesh import make_mesh
 
@@ -63,13 +64,11 @@ def test_ring_decode_prefix_matches_dense_attention():
 
 @pytest.fixture(scope="module")
 def engines():
-    cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
-    dense = LocalEngine(cfg, params=params, use_mesh=False)
-    mesh = make_mesh(4, 2)
-    ring = LocalEngine(
-        cfg, params=params, mesh=mesh,
-        sp_prefill_min_tokens=48, sp_decode=True,
+    from conftest import shared_engine
+
+    dense = shared_engine("tiny")
+    ring = shared_engine(
+        "tiny", mesh_shape=(4, 2), sp_prefill_min_tokens=48, sp_decode=True,
     )
     return dense, ring
 
@@ -119,7 +118,7 @@ def test_sp_decode_composes_with_prefix_cache_exact_hits():
     """Exact repeats of an SP-resident prompt reuse the cached seq-sharded KV
     (no re-prefill) and reproduce the same generation."""
     cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
+    params = shared_params(cfg)
     mesh = make_mesh(4, 2)
     eng = LocalEngine(
         cfg, params=params, mesh=mesh,
@@ -133,12 +132,50 @@ def test_sp_decode_composes_with_prefix_cache_exact_hits():
     np.testing.assert_array_equal(r1.tokens, r2.tokens)
 
 
+def test_sp_exact_hit_ignores_replicated_layout_entry():
+    """Regression: _sp_prefill_routed's exact-hit path used to return ANY
+    entry under the prompt key without checking its layout label — handing a
+    REPLICATED prefix to ring decode, which gathers the whole O(S) prefix
+    into every device's HBM (the exact spike sp_decode exists to avoid). A
+    wrong-layout hit must be treated as a miss and overwritten with the
+    sequence-sharded twin."""
+    cfg = get_config("tiny")
+    params = shared_params(cfg)
+    dense = shared_engine("tiny")
+    mesh = make_mesh(4, 2)
+    eng = LocalEngine(
+        cfg, params=params, mesh=mesh,
+        sp_prefill_min_tokens=48, sp_decode=True, prefix_cache_size=2,
+    )
+    # Plant a replicated-layout entry under the exact prompt key (what a
+    # replicated-path run sharing the cache would leave behind).
+    bucket = 64
+    tokens = jnp.array(
+        [PROMPT + [cfg.pad_token_id] * (bucket - len(PROMPT))], jnp.int32
+    )
+    fl, pref = eng._get_prefill(bucket)(eng.params, tokens, jnp.int32(len(PROMPT)))
+    assert not eng._kv_seq_sharded(pref)
+    eng._prefix_store(PROMPT, fl, pref, seq_sharded=False)
+
+    kw = dict(n=4, max_new_tokens=4, temperature=0.0, seed=3)
+    r = eng.generate(PROMPT, **kw)
+    assert eng.prefix_cache_stats["hits"] == 0  # wrong layout: NOT a hit
+    assert eng.prefix_cache_stats["misses"] == 1
+    entry = eng._prefix_entries[tuple(PROMPT)]
+    assert entry[4] is True
+    assert entry[1].k.sharding.spec[2] == "data"
+    np.testing.assert_array_equal(r.tokens, dense.generate(PROMPT, **kw).tokens)
+    # The overwritten (right-layout) entry now serves exact hits.
+    eng.generate(PROMPT, **kw)
+    assert eng.prefix_cache_stats["hits"] == 1
+
+
 def test_seq_sharded_cache_entry_never_partial_matches():
     """A seq-sharded (sp_decode) cache entry must be exact-hit-only: a shorter
     prompt sharing its prefix takes a full prefill (miss), never the
     replicated continuation that would all-gather the O(S) prefix."""
     cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
+    params = shared_params(cfg)
     mesh = make_mesh(4, 2)
     eng = LocalEngine(
         cfg, params=params, mesh=mesh,
@@ -162,7 +199,7 @@ def test_prefill_with_cache_labels_sp_entries_seq_sharded():
     longer prompt would partial-hit it and the replicated continuation would
     all-gather the O(S) prefix."""
     cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
+    params = shared_params(cfg)
     mesh = make_mesh(4, 2)
     eng = LocalEngine(
         cfg, params=params, mesh=mesh,
@@ -187,8 +224,8 @@ def test_generate_many_with_sp_decode_prefix_cache_bit_equal():
     from k_llms_tpu.engine.engine import GenRequestSpec
 
     cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
-    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    params = shared_params(cfg)
+    dense = shared_engine("tiny")
     mesh = make_mesh(4, 2)
     eng = LocalEngine(
         cfg, params=params, mesh=mesh,
@@ -214,8 +251,8 @@ def test_sp_partial_hit_continues_in_ring_layout():
     sequence-sharded entry, and generate tokens bit-equal to the dense
     engine's."""
     cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
-    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    params = shared_params(cfg)
+    dense = shared_engine("tiny")
     mesh = make_mesh(4, 2)
     eng = LocalEngine(
         cfg, params=params, mesh=mesh,
@@ -251,8 +288,8 @@ def test_sp_continuation_crosses_bucket_boundary():
     stored prefix grows to the new bucket (sharded pad) and outputs stay
     bit-equal to dense."""
     cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
-    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    params = shared_params(cfg)
+    dense = shared_engine("tiny")
     mesh = make_mesh(4, 2)
     eng = LocalEngine(
         cfg, params=params, mesh=mesh,
@@ -273,8 +310,8 @@ def test_sp_continuation_logprobs_match_dense():
     """Float agreement, not just greedy tokens: continuation-path logprobs
     must match the dense engine's within tolerance."""
     cfg = get_config("tiny")
-    params = init_params(cfg, jax.random.key(0))
-    dense = LocalEngine(cfg, params=params, use_mesh=False)
+    params = shared_params(cfg)
+    dense = shared_engine("tiny")
     mesh = make_mesh(4, 2)
     eng = LocalEngine(
         cfg, params=params, mesh=mesh,

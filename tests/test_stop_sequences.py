@@ -217,6 +217,31 @@ def test_visible_token_count_multibyte_boundaries():
         assert tok.decode(ids[:got])[:pos] == text[:pos]
 
 
+def test_visible_token_count_survives_nonmonotone_decode():
+    """Decoded length is NOT guaranteed non-decreasing in the token count:
+    HF-style decode cleanup (clean_up_tokenization_spaces collapsing spaces
+    before punctuation) can SHRINK the decode when a token is appended. The
+    old binary search assumed monotonicity and could land past the true
+    boundary, silently over-billing. Counterexample pinned with a cleanup
+    tokenizer: piece lengths go 3 -> 2 -> 3."""
+    from k_llms_tpu.backends.tpu import _visible_token_count
+
+    class CleanupTok:
+        _pieces = {1: "a  ", 2: ",", 3: "z"}
+
+        def decode(self, ids):
+            return "".join(self._pieces[i] for i in ids).replace("  ,", ",")
+
+    tok = CleanupTok()
+    ids = [1, 2, 3]
+    assert [len(tok.decode(ids[:k])) for k in range(4)] == [0, 3, 2, 3]
+    text = tok.decode(ids)  # "a,z"
+    pos = 2  # visible: "a,"
+    got = _visible_token_count(tok, ids, pos, text)
+    assert got == 2, got
+    assert tok.decode(ids[:got])[:pos] == text[:pos]
+
+
 def test_stop_billing_covers_multibyte_visible_text(backend):
     """End-to-end: force emoji bytes via logit_bias so the text is a soup of
     replacement chars (partial UTF-8) — exactly the boundary the length-only

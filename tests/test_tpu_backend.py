@@ -8,9 +8,21 @@ from k_llms_tpu import KLLMs
 from k_llms_tpu.backends.tpu import TpuBackend
 
 
+def _shared_tiny_engine():
+    """The session-shared tiny engine on the default (8, 1) auto mesh — same
+    construction KLLMs(backend="tpu") would do, minus the duplicate compiles."""
+    import jax
+    from conftest import shared_engine
+
+    if len(jax.devices()) == 8:
+        return shared_engine("tiny", mesh_shape=(8, 1))
+    return None  # odd device counts: let the backend pick its own auto mesh
+
+
 @pytest.fixture(scope="module")
 def client():
-    return KLLMs(backend="tpu", model="tiny", max_new_tokens=16)
+    backend = TpuBackend(model="tiny", max_new_tokens=16, engine=_shared_tiny_engine())
+    return KLLMs(backend=backend, model="tiny")
 
 
 def test_create_consensus_contract(client):
@@ -65,7 +77,7 @@ def test_logprobs_surface(client):
 @pytest.mark.slow  # 17s e2e spanning embeddings + llm-consensus; each half
 @pytest.mark.duration_budget(45)  # has dedicated tier-1 coverage
 def test_backend_embeddings_and_llm_consensus():
-    backend = TpuBackend(model="tiny", max_new_tokens=8)
+    backend = TpuBackend(model="tiny", max_new_tokens=8, engine=_shared_tiny_engine())
     embs = backend.embeddings(["alpha beta", "alpha beta", "gamma"])
     assert len(embs) == 3
     np.testing.assert_allclose(embs[0], embs[1], rtol=1e-5)
@@ -74,7 +86,7 @@ def test_backend_embeddings_and_llm_consensus():
 
 
 def test_stop_string_truncates():
-    backend = TpuBackend(model="tiny", max_new_tokens=12)
+    backend = TpuBackend(model="tiny", max_new_tokens=12, engine=_shared_tiny_engine())
     client = KLLMs(backend=backend)
     resp = client.chat.completions.create(
         messages=[{"role": "user", "content": "y"}], model="tiny", n=1, seed=3

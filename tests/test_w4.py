@@ -126,7 +126,9 @@ def test_int4_generate_end_to_end():
         vocab_size=384,
         max_seq_len=128,
     )
-    eng = LocalEngine(cfg, use_mesh=False, quantize="int4")
+    from conftest import shared_engine
+
+    eng = shared_engine(cfg, quantize="int4")
     assert eng.quantized == "int4"
     res = eng.generate([5, 6, 7], n=2, max_new_tokens=4, temperature=0.7, seed=11)
     assert res.tokens.shape == (2, 4)
@@ -203,15 +205,11 @@ def _int4_cfg():
 def test_int4_on_mesh_bitcompares_single_chip():
     """quantization="int4" survives a data=4 x model=2 mesh (shard_mapped
     w4a16) and produces the single-chip engine's exact tokens/logprobs."""
-    from k_llms_tpu.engine.engine import LocalEngine
-    from k_llms_tpu.models import init_params
-    from k_llms_tpu.parallel.mesh import make_mesh
+    from conftest import shared_engine
 
     cfg = _int4_cfg()
-    params = init_params(cfg, jax.random.key(4))
-    solo = LocalEngine(cfg, params=params, use_mesh=False, quantize="int4")
-    mesh = make_mesh(4, 2)
-    tp = LocalEngine(cfg, params=params, mesh=mesh, quantize="int4")
+    solo = shared_engine(cfg, param_key=4, quantize="int4")
+    tp = shared_engine(cfg, param_key=4, mesh_shape=(4, 2), quantize="int4")
     assert tp.quantized == "int4"  # no silent int8 downgrade any more
     assert tp.params["layers"]["wo"].part == "row"
     assert tp.params["layers"]["wq"].part == "col"
