@@ -132,6 +132,26 @@ class BackendConfig(BaseModel):
     hbm_headroom: float = 0.85
     # Default timeout for drain()/close() graceful shutdown.
     drain_timeout: float = 30.0
+    # -- self-healing supervision (PR 4) ----------------------------------
+    # Hung-launch watchdog budget: clamp(base + multiplier * max_new_tokens
+    # * per-token EWMA) seconds per device launch. The generous min floor
+    # absorbs first-launch compile time; the EWMA learns steady-state decode
+    # latency and tightens the budget from there.
+    watchdog_base_s: float = 10.0
+    watchdog_per_token_s: float = 0.5
+    watchdog_multiplier: float = 8.0
+    watchdog_min_budget_s: float = 60.0
+    watchdog_max_budget_s: float = 900.0
+    # Bounded recovery: consecutive engine rebuilds without a successful
+    # launch before the backend goes STOPPED (further requests get typed
+    # 503s instead of an unbounded rebuild loop).
+    max_rebuilds: int = 2
+    # Numeric-integrity escalation: when the aggregate poisoned-sample
+    # fraction over the last poison_window launches reaches the threshold,
+    # quarantine stops papering over the problem and the supervisor rebuilds
+    # the engine (reload weights, fresh compile).
+    poison_threshold: float = 0.5
+    poison_window: int = 8
 
 
 def _detect_hbm_bytes() -> Optional[int]:
@@ -269,26 +289,10 @@ class TpuBackend(Backend):
             raise ValueError(
                 f"Unsupported quantization {cfg.quantization!r}; use 'int8' or 'int4'"
             )
-        params = None
-        if cfg.checkpoint_path:
-            from ..models.loader import load_checkpoint
-
-            params = load_checkpoint(cfg.checkpoint_path, model_config)
-        self.engine = engine or LocalEngine(
-            model_config,
-            params=params,
-            mesh=mesh,
-            model_parallel=cfg.model_parallel,
-            param_seed=cfg.param_seed,
-            quantize=cfg.quantization or False,
-            sp_prefill_min_tokens=cfg.sp_prefill_min_tokens,
-            sp_attention=cfg.sp_attention,
-            sp_decode=cfg.sp_decode,
-            prefix_cache_size=cfg.prefix_cache_size,
-            prefix_cache_min_reuse=cfg.prefix_cache_min_reuse,
-            speculative=cfg.speculative,
-            spec_lookahead=cfg.spec_lookahead,
-        )
+        self._model_config = model_config
+        self._mesh = mesh
+        self.param_summary: Optional[Dict[str, Any]] = None
+        self.engine = engine if engine is not None else self._build_engine()
         self.default_max_new_tokens = cfg.max_new_tokens
         # HBM memory model: caps the rows any coalesced group may fuse to for
         # a given request shape (prompt + max_new KV per row), per-request via
@@ -320,14 +324,88 @@ class TpuBackend(Backend):
             max_queue_weight=cfg.max_queue_weight,
             **scheduler_kwargs,
         )
-        # Device-OOM feedback loop: the engine's guard reports each caught
-        # RESOURCE_EXHAUSTED (scheduler halves its coalescing width) and each
-        # clean launch (width steps back up, DEGRADED clears).
+        # Self-healing supervision: every device launch runs under the
+        # watchdog; a hung or poison-escalated engine is rebuilt through
+        # _rebuild_engine and the launch replayed on the new engine. The
+        # hooks ARE the scheduler's RECOVERING / READY / STOPPED transitions.
+        from ..reliability.supervisor import EngineSupervisor, LaunchBudgetModel
+
+        self.supervisor = EngineSupervisor(
+            rebuild_fn=self._rebuild_engine,
+            budget_model=LaunchBudgetModel(
+                base_s=cfg.watchdog_base_s,
+                per_token_s=cfg.watchdog_per_token_s,
+                multiplier=cfg.watchdog_multiplier,
+                min_budget_s=cfg.watchdog_min_budget_s,
+                max_budget_s=cfg.watchdog_max_budget_s,
+            ),
+            max_rebuilds=cfg.max_rebuilds,
+            poison_threshold=cfg.poison_threshold,
+            poison_window=cfg.poison_window,
+            on_recovering=self.scheduler.note_recovering,
+            on_rebuilt=self.scheduler.note_rebuilt,
+            on_rebuild_failed=self.scheduler.note_rebuild_failed,
+        )
+        self._wire_engine_hooks()
+        self._closed = False
+        self._dfa_cache: Dict[str, Any] = {}
+
+    # -- engine lifecycle --------------------------------------------------
+    def _build_engine(self) -> LocalEngine:
+        """Construct (or re-construct) the engine: checkpoint reload through
+        the loader — integrity-verified, so a corrupt checkpoint raises
+        CheckpointCorruptError before any compile — plus fresh jit caches.
+        Shared by __init__ and the supervisor's rebuild path so a recovery
+        lands on exactly the weights a cold start would load (same
+        checkpoint, or the same param_seed when running seeded)."""
+        cfg = self.backend_config
+        params = None
+        self.param_summary = None
+        if cfg.checkpoint_path:
+            from ..models import loader as _loader
+
+            params = _loader.load_checkpoint(cfg.checkpoint_path, self._model_config)
+            self.param_summary = _loader.last_load_summary
+        return LocalEngine(
+            self._model_config,
+            params=params,
+            mesh=self._mesh,
+            model_parallel=cfg.model_parallel,
+            param_seed=cfg.param_seed,
+            quantize=cfg.quantization or False,
+            sp_prefill_min_tokens=cfg.sp_prefill_min_tokens,
+            sp_attention=cfg.sp_attention,
+            sp_decode=cfg.sp_decode,
+            prefix_cache_size=cfg.prefix_cache_size,
+            prefix_cache_min_reuse=cfg.prefix_cache_min_reuse,
+            speculative=cfg.speculative,
+            spec_lookahead=cfg.spec_lookahead,
+        )
+
+    def _wire_engine_hooks(self) -> None:
+        """Device-OOM feedback loop (the engine's guard reports each caught
+        RESOURCE_EXHAUSTED so the scheduler halves its coalescing width, and
+        each clean launch so width steps back up and DEGRADED clears) plus
+        the quarantine feed. Re-run after every rebuild so the feedback
+        follows the NEW engine, not the wedged one."""
         self.engine.on_oom = self.scheduler.note_oom
         self.engine.on_launch_ok = self.scheduler.note_recovered
         self.engine.on_spec_stats = self.scheduler.note_spec_stats
-        self._closed = False
-        self._dfa_cache: Dict[str, Any] = {}
+        self.engine.on_quarantine = self._on_quarantine
+
+    def _on_quarantine(self, poisoned: int, total: int) -> None:
+        # Fires after EVERY launch (poisoned=0 when clean) so the
+        # supervisor's escalation window decays under healthy traffic.
+        self.scheduler.note_quarantine(poisoned)
+        self.supervisor.note_poison(poisoned, total)
+
+    def _rebuild_engine(self) -> None:
+        """Supervisor rebuild_fn: drop the wedged engine and stand up a fresh
+        one. The old engine is simply unreferenced — its device buffers are
+        reclaimed by the runtime once the abandoned launch thread (if any)
+        releases them; explicit teardown would race that thread."""
+        self.engine = self._build_engine()
+        self._wire_engine_hooks()
 
     # -- chat -------------------------------------------------------------
     def chat_completion(self, request: ChatRequest) -> ChatCompletion:
@@ -550,19 +628,38 @@ class TpuBackend(Backend):
             frequency_penalty, presence_penalty, bias_key, stop_key,
         )
 
+        # Pin the sampling seed at SUBMISSION time: with seed=None the engine
+        # would draw fresh entropy per launch, so a watchdog-triggered replay
+        # of this request would sample different tokens than the abandoned
+        # attempt. Pinning here makes replay byte-identical to an
+        # uninterrupted run (same weights after reload + same key derivation).
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+
         def run(specs):
-            return self.engine.generate_many(
-                specs,
+            dp_now = self.engine.data_parallel_size
+            launch_rows = sum(
+                ((max(1, s.n) + dp_now - 1) // dp_now) * dp_now for s in specs
+            )
+            # The lambda re-resolves self.engine at call time, so when the
+            # supervisor rebuilds mid-launch the replay lands on the NEW
+            # engine — that is the whole recovery contract.
+            return self.supervisor.supervised_launch(
+                lambda: self.engine.generate_many(
+                    specs,
+                    max_new_tokens=max_new,
+                    temperature=temperature,
+                    top_p=top_p,
+                    eos_ids=eos_ids,
+                    constraint=constraint,
+                    top_logprobs=top_logprobs,
+                    frequency_penalty=frequency_penalty,
+                    presence_penalty=presence_penalty,
+                    logit_bias=logit_bias,
+                    stop_sequences=stop_sequences,
+                ),
+                rows=launch_rows,
                 max_new_tokens=max_new,
-                temperature=temperature,
-                top_p=top_p,
-                eos_ids=eos_ids,
-                constraint=constraint,
-                top_logprobs=top_logprobs,
-                frequency_penalty=frequency_penalty,
-                presence_penalty=presence_penalty,
-                logit_bias=logit_bias,
-                stop_sequences=stop_sequences,
             )
 
         # Weight = this request's padded row count (the engine rounds n up to a
@@ -663,7 +760,13 @@ class TpuBackend(Backend):
         def run(payloads):
             # Concurrent requests' embedding batches coalesce into one forward.
             flat = [tl for p in payloads for tl in p]
-            pooled = self.engine.embed_tokens(flat)
+            # One forward, no decode loop: supervise it as a 1-token launch so
+            # a wedged embedding launch heals like a wedged decode does.
+            pooled = self.supervisor.supervised_launch(
+                lambda: self.engine.embed_tokens(flat),
+                rows=max(1, len(flat)),
+                max_new_tokens=1,
+            )
             out, i = [], 0
             for p in payloads:
                 out.append(pooled[i : i + len(p)])
@@ -706,6 +809,13 @@ class TpuBackend(Backend):
         snap["breaker"] = self.circuit_breaker.state
         snap["engine_oom"] = dict(self.engine.oom_stats)
         snap["memory_model"] = self.memory_model.describe()
+        snap["supervisor"] = self.supervisor.stats()
+        snap["quarantine"] = dict(
+            getattr(self.engine, "quarantine_stats", None) or {}
+        )
+        # Loader's param summary (total bytes, dtype histogram, checksum) —
+        # None when the engine runs on seeded params rather than a checkpoint.
+        snap["params"] = self.param_summary
         return snap
 
     def drain(self, timeout: Optional[float] = None) -> bool:

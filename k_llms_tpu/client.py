@@ -116,8 +116,12 @@ class _BaseKLLMs:
 
     # -- lifecycle --------------------------------------------------------
     def health(self) -> Any:
-        """Serving-health snapshot from the backend (scheduler lifecycle
-        state, queue depth/weight, shed/OOM counters, breaker state)."""
+        """Serving-health snapshot from the backend: scheduler lifecycle
+        state (including RECOVERING while the supervisor rebuilds a hung or
+        poisoned engine), queue depth/weight, shed/OOM counters, breaker
+        state, supervisor stats (epoch, hung launches, rebuilds, replay
+        count), quarantine counters, and the loader's param summary (total
+        bytes, dtype histogram, checksum) when a checkpoint is loaded."""
         return self._backend.health()
 
     def drain(self, timeout: Optional[float] = None) -> bool:

@@ -173,9 +173,13 @@ def _consensus_with_degrade(
 def _degraded_info(choices) -> Optional[Dict[str, Any]]:
     """Partial-failure accounting from the backend's per-choice
     ``sample_error`` extensions (samples lost mid-decode to a fault, abort,
-    or injected kill). None when every sample is healthy. Distinct from a
-    sample that merely returned EMPTY content — that is a model outcome, not
-    a failure, and must not trigger degraded marking or likelihood scaling."""
+    injected kill, or the numeric-integrity quarantine's ``numeric_poison``
+    code — a sample whose logits went NaN/Inf/degenerate mid-decode and was
+    excluded rather than allowed to vote garbage). None when every sample is
+    healthy. Distinct from a sample that merely returned EMPTY content — that
+    is a model outcome, not a failure, and must not trigger degraded marking
+    or likelihood scaling. ``error_codes`` breaks the losses down by typed
+    code so operators can tell quarantine from timeouts at a glance."""
     errors: List[Dict[str, Any]] = []
     for i, choice in enumerate(choices):
         err = getattr(choice, "sample_error", None)
@@ -185,11 +189,16 @@ def _degraded_info(choices) -> Optional[Dict[str, Any]]:
         return None
     requested = len(choices)
     survived = requested - len(errors)
+    by_code: Dict[str, int] = {}
+    for e in errors:
+        code = str(e.get("code") or "unknown")
+        by_code[code] = by_code.get(code, 0) + 1
     return {
         "requested": requested,
         "survived": survived,
         "survival_fraction": survived / requested,
         "sample_errors": errors,
+        "error_codes": by_code,
     }
 
 

@@ -49,7 +49,7 @@ from ..parallel.sharding import batch_spec, cache_specs, param_specs
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, KLLMsError
-from ..utils.observability import FAILURE_EVENTS
+from ..utils.observability import FAILURE_EVENTS, QUARANTINE_EVENTS
 
 logger = logging.getLogger(__name__)
 
@@ -179,6 +179,31 @@ def _kill_sample_errors(n: int, fp: "_failpoints.FailSpec") -> List[Optional[Dic
             "message": "sample lost mid-decode (injected failpoint engine.decode)",
         }
     return errs
+
+
+def _quarantine_error() -> Dict[str, Any]:
+    return {
+        "type": "server_error",
+        "code": "numeric_poison",
+        "message": (
+            "sample quarantined: non-finite or degenerate logits detected "
+            "mid-decode"
+        ),
+    }
+
+
+def _poisoned_logits(logits: jax.Array) -> jax.Array:
+    """[B, V] -> [B] bool: rows whose logits are numerically poisoned — any
+    NaN or +Inf anywhere, or EVERY column -Inf (a fully-degenerate
+    distribution nothing can be sampled from). Partial -Inf is normal
+    (constraint/pad masks), so only the all-masked case counts.
+
+    Runs inside the jitted decode loops each step; it is a reduction over
+    logits the step already materialized, so the cost is one fused elementwise
+    pass — the price of never letting a poisoned row reach consensus."""
+    bad_val = jnp.any(jnp.isnan(logits) | (logits == jnp.inf), axis=-1)
+    degenerate = jnp.max(logits, axis=-1) == -jnp.inf
+    return jnp.logical_or(bad_val, degenerate)
 
 
 def _bucket(n: int, minimum: int = 32) -> int:
@@ -411,6 +436,12 @@ class LocalEngine:
         # the scheduler/observability layer can aggregate drafted/accepted
         # without polling the engine.
         self.on_spec_stats: Optional[Any] = None
+        # Numeric-integrity quarantine: cumulative counts plus a per-launch
+        # hook (poisoned_rows, total_rows) the supervisor subscribes to for
+        # poison-rate escalation. Clean launches report (0, total) so the
+        # supervisor's rate window decays.
+        self.quarantine_stats: Dict[str, int] = {"samples": 0, "launches": 0}
+        self.on_quarantine: Optional[Any] = None
 
         self._prefill_cache: Dict[Any, Any] = {}
         self._sp_prefill_cache: Dict[Any, Any] = {}
@@ -874,6 +905,13 @@ class LocalEngine:
         if not killed:
             return result
         FAILURE_EVENTS.record("engine.samples_killed", len(killed))
+        if result.sample_errors:
+            # Compose with earlier per-sample faults (e.g. quarantine): a kill
+            # overwrites, everything else survives.
+            errs = [
+                e if e is not None else prev
+                for e, prev in zip(errs, result.sample_errors)
+            ]
         toks = result.tokens.copy()
         lps = result.logprobs.copy()
         lengths = result.lengths.copy()
@@ -881,6 +919,75 @@ class LocalEngine:
             toks[i, :] = self.config.pad_token_id
             lps[i, :] = 0.0
             lengths[i] = 0
+        return result._replace(
+            tokens=toks, logprobs=lps, lengths=lengths, sample_errors=errs
+        )
+
+    # -- numeric-integrity quarantine --------------------------------------
+    def _poison0_array(self, n_rows: int, live_rows: Optional[Sequence[int]] = None) -> jax.Array:
+        """First-step poison-injection mask [n_rows] bool for the decode
+        loops: all-False in production; with an active ``engine.logits`` nan
+        failpoint, a seeded subset of the LIVE rows (padding rows excluded —
+        their poison would be invisible) is poisoned. The zeros mask is cached
+        per width so the hot path pays no per-launch transfer."""
+        fp = _failpoints.fire("engine.logits")
+        if fp is not None and fp.action == "nan" and fp.kill > 0:
+            rows = list(live_rows) if live_rows is not None else list(range(n_rows))
+            rng = _pyrandom.Random(fp.seed)
+            chosen = rng.sample(rows, min(fp.kill, len(rows)))
+            mask = np.zeros((n_rows,), np.bool_)
+            mask[chosen] = True
+            return jnp.asarray(mask)
+        cache = getattr(self, "_zero_poison", None)
+        if cache is None:
+            cache = {}
+            self._zero_poison = cache
+        cached = cache.get(n_rows)
+        if cached is None:
+            cached = jnp.zeros((n_rows,), jnp.bool_)
+            cache[n_rows] = cached
+        return cached
+
+    def _note_quarantine(self, poisoned: int, total: int) -> None:
+        """Per-launch quarantine accounting + supervisor hook. Called for
+        EVERY launch (clean ones report poisoned=0) so a rate window decays."""
+        if poisoned:
+            self.quarantine_stats["samples"] += poisoned
+            self.quarantine_stats["launches"] += 1
+            QUARANTINE_EVENTS.record("quarantine.samples", poisoned)
+            QUARANTINE_EVENTS.record("quarantine.launches")
+            logger.warning(
+                "numeric poison: %d/%d decode row(s) quarantined this launch",
+                poisoned,
+                total,
+            )
+        if self.on_quarantine is not None:
+            self.on_quarantine(poisoned, total)
+
+    def _quarantine_result(
+        self, result: GenerationResult, pois_rows: np.ndarray
+    ) -> GenerationResult:
+        """Clear quarantined sample rows (tokens→pad, logprobs→0, length→0)
+        and mark them as partial-failure members (``sample_errors`` code
+        ``numeric_poison``) so PR-1 survivor consensus drops them from the
+        vote and scales likelihoods — healthy samples in the same request are
+        untouched."""
+        killed = np.flatnonzero(pois_rows[: result.tokens.shape[0]])
+        if killed.size == 0:
+            return result
+        toks = result.tokens.copy()
+        lps = result.logprobs.copy()
+        lengths = result.lengths.copy()
+        errs = (
+            list(result.sample_errors)
+            if result.sample_errors
+            else [None] * toks.shape[0]
+        )
+        for i in killed:
+            toks[i, :] = self.config.pad_token_id
+            lps[i, :] = 0.0
+            lengths[i] = 0
+            errs[i] = _quarantine_error()
         return result._replace(
             tokens=toks, logprobs=lps, lengths=lengths, sample_errors=errs
         )
@@ -952,12 +1059,16 @@ class LocalEngine:
 
         def _loop(
             params, prefix: KVCache, prompt_lens, first_logits, req_keys, eos_ids,
-            bias, stops,
+            bias, stops, poison0,
         ):
             # ``bias`` [V] f32 (zeros when use_logit_bias is False — a dead
             # arg then, kept so the signature is uniform): OpenAI logit_bias,
             # applied via the penalty mechanism so reported logprobs stay the
             # unbiased model distribution's.
+            # ``poison0`` [B] bool: rows whose first-step logits are forced to
+            # NaN (the ``engine.logits`` nan failpoint — all False in
+            # production), exercising the same quarantine path a real
+            # device-corruption would take.
             # ``stops`` [MAX_STOP_SEQS, MAX_STOP_LEN] int32: tokenized stop
             # sequences, right-aligned and -1-padded; all -1 when unused. A
             # row halts the step its recent-token window matches any stop
@@ -991,16 +1102,25 @@ class LocalEngine:
             if jstate is not None:
                 logits0 = mask_logits(jt, logits0, *jstate, eos_ids)
             logits0 = _mask_pad(logits0)
+            # Numeric-integrity quarantine, step 0: detect poisoned rows
+            # (after injection), then sanitize them to a uniform distribution
+            # so sampling's top-p bisection stays well-defined — the row's
+            # output is discarded anyway (token forced to pad, row frozen).
+            logits0 = jnp.where(poison0[:, None], jnp.nan, logits0)
+            bad0 = _poisoned_logits(logits0)
+            logits0 = jnp.where(bad0[:, None], 0.0, logits0)
             tok0, lp0 = sample(
                 logits0,
                 None,
                 row_keys=_row_keys(req_keys, jnp.int32(0)),
                 penalty=-bias[None, :] if use_logit_bias else None,
             )
+            tok0 = jnp.where(bad0, pad_id, tok0).astype(jnp.int32)
+            lp0 = jnp.where(bad0, 0.0, lp0)
             tok0 = self._constraint(tok0, batch_spec())
             if jstate is not None:
                 jstate = advance(jt, tok0, *jstate)
-            done0 = jnp.isin(tok0, eos_ids)
+            done0 = jnp.logical_or(jnp.isin(tok0, eos_ids), bad0)
 
             def _stop_match(recent):
                 return stop_window_match(recent, stops)
@@ -1053,7 +1173,7 @@ class LocalEngine:
                 return jnp.logical_and(step < max_new - 1, jnp.logical_not(jnp.all(done)))
 
             def body(state):
-                step, cur, done, cache, toks, lps, tt, tl, counts, jst, recent = state
+                step, cur, done, cache, toks, lps, tt, tl, counts, jst, recent, pois = state
                 logits, cache = decode_step(
                     config, params, cur, step, prompt_lens, cache, prefix,
                     sp_ring_mesh=self.mesh if sp_prefix else None,
@@ -1061,17 +1181,23 @@ class LocalEngine:
                 if jst is not None:
                     logits = mask_logits(jt, logits, *jst, eos_ids)
                 logits = _mask_pad(logits)
+                # Quarantine: a live row whose logits went non-finite freezes
+                # exactly like an eos row (sanitized before sampling so the
+                # sampler never sees NaN) and is flagged in ``pois``.
+                bad = jnp.logical_and(_poisoned_logits(logits), jnp.logical_not(done))
+                logits = jnp.where(bad[:, None], 0.0, logits)
+                frozen = jnp.logical_or(done, bad)
                 nxt, lp = sample(
                     logits,
                     None,
                     row_keys=_row_keys(req_keys, step + 1),
                     penalty=_penalty(counts),
                 )
-                nxt = jnp.where(done, pad_id, nxt).astype(jnp.int32)
+                nxt = jnp.where(frozen, pad_id, nxt).astype(jnp.int32)
                 nxt = self._constraint(nxt, batch_spec())
                 if jst is not None:
                     jst = advance(jt, nxt, *jst)  # pad/eos (>=256) freeze the row
-                lp = jnp.where(done, 0.0, lp)
+                lp = jnp.where(frozen, 0.0, lp)
                 toks = lax.dynamic_update_slice(toks, nxt[:, None], (0, step + 1))
                 lps = lax.dynamic_update_slice(lps, lp[:, None], (0, step + 1))
                 if K:
@@ -1081,9 +1207,10 @@ class LocalEngine:
                 if penalized:
                     # Finished rows emit pad_id; don't count it.
                     counts = counts.at[jnp.arange(B), nxt].add(
-                        jnp.where(done, 0.0, 1.0)
+                        jnp.where(frozen, 0.0, 1.0)
                     )
-                done = jnp.logical_or(done, jnp.isin(nxt, eos_ids))
+                done = jnp.logical_or(frozen, jnp.isin(nxt, eos_ids))
+                pois = jnp.logical_or(pois, bad)
                 if use_stops:
                     recent = jnp.concatenate([recent[:, 1:], nxt[:, None]], axis=1)
                     done = jnp.logical_or(done, _stop_match(recent))
@@ -1094,16 +1221,16 @@ class LocalEngine:
                     # (rows are request-major, hence the n_per repeat).
                     aborted = abort_poll(step)
                     done = jnp.logical_or(done, jnp.repeat(aborted, n_per))
-                return (step + 1, nxt, done, cache, toks, lps, tt, tl, counts, jst, recent)
+                return (step + 1, nxt, done, cache, toks, lps, tt, tl, counts, jst, recent, pois)
 
             state = (
                 jnp.int32(0), tok0, done0, gen_cache, tokens_buf, logprob_buf,
-                tt_buf, tl_buf, counts0, jstate, recent0,
+                tt_buf, tl_buf, counts0, jstate, recent0, bad0,
             )
-            step, cur, done, cache, toks, lps, tt, tl, _, _, _ = lax.while_loop(
+            step, cur, done, cache, toks, lps, tt, tl, _, _, _, pois = lax.while_loop(
                 cond, body, state
             )
-            return toks, lps, done, tt, tl
+            return toks, lps, done, tt, tl, pois
 
         fn = jax.jit(_loop)
         self._decode_cache[cache_key] = fn
@@ -1204,7 +1331,7 @@ class LocalEngine:
 
         def _loop(
             params, prefix, prompt_tokens, prompt_lens, first_logits, req_keys,
-            eos_ids, bias, stops,
+            eos_ids, bias, stops, poison0,
         ):
             # prompt_tokens [R, S] / prompt_lens [R]: each request's padded
             # prompt table; rows are request-major so row b drafts from table
@@ -1232,12 +1359,19 @@ class LocalEngine:
             if jstate is not None:
                 logits0 = mask_logits(jt, logits0, *jstate, eos_ids)
             logits0 = _mask_pad(logits0)
+            # Numeric-integrity quarantine, step 0 (see the normal loop):
+            # inject, detect, sanitize, freeze.
+            logits0 = jnp.where(poison0[:, None], jnp.nan, logits0)
+            bad0 = _poisoned_logits(logits0)
+            logits0 = jnp.where(bad0[:, None], 0.0, logits0)
             tok0, lp0 = sample(
                 logits0,
                 None,
                 row_keys=_row_keys(req_keys, 0),
                 penalty=-bias[None, :] if use_logit_bias else None,
             )
+            tok0 = jnp.where(bad0, pad_id, tok0).astype(jnp.int32)
+            lp0 = jnp.where(bad0, 0.0, lp0)
             tok0 = self._constraint(tok0, batch_spec())
             if jstate is not None:
                 jstate = advance(jt, tok0, *jstate)
@@ -1263,7 +1397,7 @@ class LocalEngine:
                 eos0 = eos0 | _stop_match(recent0)  # "stop" finish either way
             else:
                 recent0 = jnp.zeros((B, 0), jnp.int32)
-            done0 = eos0 | (count0 >= max_new)
+            done0 = eos0 | bad0 | (count0 >= max_new)
 
             gen_cache = init_cache(config, B, BUF)
             gen_cache = KVCache(
@@ -1278,7 +1412,7 @@ class LocalEngine:
             def body(state):
                 (
                     it, count, done, hit_eos_any, row_iters, cache, toks, lps,
-                    tt, tlb, vcounts, jst, recent,
+                    tt, tlb, vcounts, jst, recent, pois,
                 ) = state
                 row_iters = row_iters + jnp.where(done, 0, 1)  # verifies entered
                 cur = jnp.take_along_axis(toks, (count - 1)[:, None], axis=1)[:, 0]
@@ -1321,6 +1455,15 @@ class LocalEngine:
                 # (iteration, position) then row, so every (position, row)
                 # draw is independent and reproducible.
                 flat = _mask_pad(logits.reshape(B * (K + 1), V))
+                # Quarantine: a live row whose verify-block logits went
+                # non-finite at ANY position emits nothing this iteration and
+                # freezes (budget forced to 0 below); sanitized so the single
+                # flattened sampling call stays well-defined.
+                badrow = jnp.logical_and(
+                    jnp.any(_poisoned_logits(flat).reshape(B, K + 1), axis=1),
+                    jnp.logical_not(done),
+                )
+                flat = jnp.where(jnp.repeat(badrow, K + 1)[:, None], 0.0, flat)
                 pen_flat = None
                 if penalized:
                     # Position j's counts = emitted counts + drafts[:j]; the
@@ -1366,7 +1509,7 @@ class LocalEngine:
                 )
                 lp_arr = lp_flat.reshape(B, K + 1)
 
-                budget = jnp.where(done, 0, max_new - count)
+                budget = jnp.where(done | badrow, 0, max_new - count)
                 emit, counts_new, hit_eos = accept_drafts(
                     sampled, drafts, eos_ids, budget
                 )
@@ -1427,7 +1570,8 @@ class LocalEngine:
                     )
                 count = count + counts_new
                 hit_eos_any = hit_eos_any | hit_eos | stop_hit
-                done = done | hit_eos | stop_hit | (count >= max_new)
+                done = done | hit_eos | stop_hit | badrow | (count >= max_new)
+                pois = pois | badrow
                 if use_cancel:
                     # Same between-step cancellation poll as the normal loop
                     # (see _abort_poller); one verify block may still complete
@@ -1436,20 +1580,20 @@ class LocalEngine:
                     done = done | jnp.repeat(aborted, n_per)
                 return (
                     it + 1, count, done, hit_eos_any, row_iters, cache, toks, lps,
-                    tt, tlb, vcounts, jst, recent,
+                    tt, tlb, vcounts, jst, recent, pois,
                 )
 
             state = (
                 jnp.int32(1), count0, done0, eos0,
                 jnp.zeros((B,), jnp.int32), gen_cache, toks, lps,
-                tt, tlb, vcounts0, jstate, recent0,
+                tt, tlb, vcounts0, jstate, recent0, bad0,
             )
-            _, count, _, hit_eos_any, row_iters, _, toks, lps, tt, tlb, _, _, _ = (
+            _, count, _, hit_eos_any, row_iters, _, toks, lps, tt, tlb, _, _, _, pois = (
                 lax.while_loop(cond, body, state)
             )
             return (
                 toks[:, :max_new], lps[:, :max_new], hit_eos_any, count, row_iters,
-                tt[:, :max_new], tlb[:, :max_new],
+                tt[:, :max_new], tlb[:, :max_new], pois,
             )
 
         fn = jax.jit(_loop)
@@ -1502,19 +1646,21 @@ class LocalEngine:
         )
         self._active_budgets = [budget]
         try:
-            toks, lps, hit_eos, count, row_iters, tt, tl = loop(
+            toks, lps, hit_eos, count, row_iters, tt, tl, pois = loop(
                 self.params, prefix, prompt_buf, jnp.array([prompt_len], jnp.int32),
                 first_logits, jnp.stack([jax.random.key(seed)]), eos_arr,
                 self._bias_array(logit_bias),
                 stop_arr if stop_arr is not None else self._stop_array(None)[0],
+                self._poison0_array(n_padded, range(n)),
             )
-            toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
+            toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np, pois_np = map(
                 np.asarray,
-                jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
+                jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl, pois)),
             )
         finally:
             self._active_budgets = None
         toks_np, lps_np, eos_np = toks_np[:n], lps_np[:n], eos_np[:n]
+        pois_np = pois_np[:n]
         spec_stats = _spec_acceptance_stats(
             count_np[:n], iters_np[:n], lookahead=self.spec_lookahead
         )
@@ -1525,15 +1671,19 @@ class LocalEngine:
         # a pad-mapped-to-eos stop token is excluded identically in both modes
         # (emitted tokens are otherwise never pad — pad is masked at sampling).
         lengths = (toks_np != config.pad_token_id).sum(axis=1).astype(np.int32)
-        return GenerationResult(
-            tokens=toks_np,
-            logprobs=lps_np,
-            lengths=lengths,
-            finish_reasons=["stop" if d else "length" for d in eos_np],
-            prompt_len=prompt_len,
-            top_tokens=tt_np[:n] if top_logprobs else None,
-            top_logprobs=tl_np[:n] if top_logprobs else None,
-            spec_stats=spec_stats,
+        self._note_quarantine(int(pois_np.sum()), n)
+        return self._quarantine_result(
+            GenerationResult(
+                tokens=toks_np,
+                logprobs=lps_np,
+                lengths=lengths,
+                finish_reasons=["stop" if d else "length" for d in eos_np],
+                prompt_len=prompt_len,
+                top_tokens=tt_np[:n] if top_logprobs else None,
+                top_logprobs=tl_np[:n] if top_logprobs else None,
+                spec_stats=spec_stats,
+            ),
+            pois_np,
         )
 
     def _finish_many_speculative(
@@ -1553,15 +1703,21 @@ class LocalEngine:
             use_stops=use_stops,
             use_cancel=use_cancel,
         )
+        live = [
+            i
+            for j, it in enumerate(items)
+            for i in range(j * n_per, j * n_per + max(1, it.n))
+        ]
         self._active_budgets = [it.budget for it in items]
         try:
-            toks, lps, hit_eos, count, row_iters, tt, tl = loop(
+            toks, lps, hit_eos, count, row_iters, tt, tl, pois = loop(
                 self.params, prefix, prompt_bufs, prompt_lens, first_logits,
                 req_keys, eos_arr, self._bias_array(logit_bias), stop_arr,
+                self._poison0_array(r_pad * n_per, live),
             )
-            toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np = map(
+            toks_np, lps_np, eos_np, count_np, iters_np, tt_np, tl_np, pois_np = map(
                 np.asarray,
-                jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl)),
+                jax.device_get((toks, lps, hit_eos, count, row_iters, tt, tl, pois)),
             )
         finally:
             self._active_budgets = None
@@ -1571,15 +1727,12 @@ class LocalEngine:
             spec_stats_fn=lambda lo, n_j: _spec_acceptance_stats(
                 count_np[lo : lo + n_j], iters_np[lo : lo + n_j]
             ),
+            pois_np=pois_np,
         )
         # The engine-level mirror summarizes the whole coalesced batch (real
         # rows only — per-request row padding and batch padding excluded).
-        idx = np.concatenate(
-            [
-                np.arange(j * n_per, j * n_per + max(1, it.n))
-                for j, it in enumerate(items)
-            ]
-        )
+        idx = np.asarray(live, np.int64)
+        self._note_quarantine(int(pois_np[idx].sum()), len(idx))
         self.spec_stats = {
             "coalesced_requests": len(items),
             **_spec_acceptance_stats(
@@ -1592,30 +1745,33 @@ class LocalEngine:
 
     def _slice_many_results(
         self, items, preps, n_per, toks_np, lps_np, finish_np, tt_np, tl_np,
-        top_logprobs, spec_stats_fn,
+        top_logprobs, spec_stats_fn, pois_np=None,
     ) -> List[GenerationResult]:
         """Shared generate_many result assembly (normal AND speculative
         coalesced paths): per-request row slices, non-pad lengths, stop/length
-        finish reasons — one place for the conventions."""
+        finish reasons — one place for the conventions. ``pois_np`` [B] marks
+        quarantined rows; each request's slice is scrubbed independently so
+        one poisoned member never contaminates its batch peers."""
         results: List[GenerationResult] = []
         for j, (it, (_, prompt_len, _)) in enumerate(zip(items, preps)):
             lo, n_j = j * n_per, max(1, it.n)
             t = toks_np[lo : lo + n_j]
             lengths = (t != self.config.pad_token_id).sum(axis=1).astype(np.int32)
-            results.append(
-                GenerationResult(
-                    tokens=t,
-                    logprobs=lps_np[lo : lo + n_j],
-                    lengths=lengths,
-                    finish_reasons=[
-                        "stop" if d else "length" for d in finish_np[lo : lo + n_j]
-                    ],
-                    prompt_len=prompt_len,
-                    top_tokens=tt_np[lo : lo + n_j] if top_logprobs else None,
-                    top_logprobs=tl_np[lo : lo + n_j] if top_logprobs else None,
-                    spec_stats=spec_stats_fn(lo, n_j),
-                )
+            res = GenerationResult(
+                tokens=t,
+                logprobs=lps_np[lo : lo + n_j],
+                lengths=lengths,
+                finish_reasons=[
+                    "stop" if d else "length" for d in finish_np[lo : lo + n_j]
+                ],
+                prompt_len=prompt_len,
+                top_tokens=tt_np[lo : lo + n_j] if top_logprobs else None,
+                top_logprobs=tl_np[lo : lo + n_j] if top_logprobs else None,
+                spec_stats=spec_stats_fn(lo, n_j),
             )
+            if pois_np is not None:
+                res = self._quarantine_result(res, pois_np[lo : lo + n_j])
+            results.append(res)
         return results
 
     def _stop_array(
@@ -1824,7 +1980,7 @@ class LocalEngine:
         )
         self._active_budgets = [budget]
         try:
-            toks, lps, done, tt, tl = loop(
+            toks, lps, done, tt, tl, pois = loop(
                 self.params,
                 prefix,
                 jnp.array([prompt_len], jnp.int32),
@@ -1833,20 +1989,22 @@ class LocalEngine:
                 eos_arr,
                 self._bias_array(logit_bias),
                 stop_arr,
+                self._poison0_array(n_padded, range(n)),
             )
 
             # ONE host transfer for all outputs: on relayed/remote device
             # platforms every device_get pays a full round trip (~74 ms through
             # the axon relay), so fetching the buffers separately would
             # multiply it.
-            toks_np, lps_np, done_np, tt_np, tl_np = jax.device_get(
-                (toks, lps, done, tt, tl)
+            toks_np, lps_np, done_np, tt_np, tl_np, pois_np = jax.device_get(
+                (toks, lps, done, tt, tl, pois)
             )
         finally:
             self._active_budgets = None
         toks_np = np.asarray(toks_np)[:n]
         lps_np = np.asarray(lps_np)[:n]
         done_np = np.asarray(done_np)[:n]
+        pois_np = np.asarray(pois_np)[:n]
 
         lengths = (toks_np != config.pad_token_id).sum(axis=1).astype(np.int32)
         # A sample that emitted pad_id as a real token would undercount; the
@@ -1862,6 +2020,8 @@ class LocalEngine:
             top_logprobs=np.asarray(tl_np)[:n] if top_logprobs else None,
             spec_stats=spec_stats,
         )
+        self._note_quarantine(int(pois_np.sum()), n)
+        result = self._quarantine_result(result, pois_np)
         return self._apply_decode_faults(result, budget)
 
     def generate_many(
@@ -2091,20 +2251,29 @@ class LocalEngine:
             use_stops=use_stops,
             use_cancel=use_cancel,
         )
+        live = [
+            i
+            for j, it in enumerate(items)
+            for i in range(j * n_per, j * n_per + max(1, it.n))
+        ]
         self._active_budgets = [it.budget for it in items]
         try:
-            toks, lps, done, tt, tl = loop(
+            toks, lps, done, tt, tl, pois = loop(
                 self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr,
                 self._bias_array(logit_bias), stop_arr,
+                self._poison0_array(r_pad * n_per, live),
             )
-            toks_np, lps_np, done_np, tt_np, tl_np = map(
-                np.asarray, jax.device_get((toks, lps, done, tt, tl))
+            toks_np, lps_np, done_np, tt_np, tl_np, pois_np = map(
+                np.asarray, jax.device_get((toks, lps, done, tt, tl, pois))
             )
         finally:
             self._active_budgets = None
         results = self._slice_many_results(
             items, preps, n_per, toks_np, lps_np, done_np, tt_np, tl_np,
-            top_logprobs, spec_stats_fn=lambda lo, n_j: {},
+            top_logprobs, spec_stats_fn=lambda lo, n_j: {}, pois_np=pois_np,
+        )
+        self._note_quarantine(
+            int(pois_np[np.asarray(live, np.int64)].sum()), len(live)
         )
         return self._finalize_many(items, results)
 

@@ -70,6 +70,9 @@ class ServerState(str, enum.Enum):
     READY     serving normally.
     DEGRADED  serving, but a device OOM forced the coalescing width down;
               clears back to READY once launches succeed at full width.
+    RECOVERING  the supervisor is rebuilding a hung/poisoned engine; admission
+              stays OPEN (work queues behind the rebuild and is replayed on
+              the fresh engine) — callers see latency, not rejections.
     DRAINING  admission closed (503); in-flight + queued work finishing.
     STOPPED   worker joined; all submission rejected.
     """
@@ -77,6 +80,7 @@ class ServerState(str, enum.Enum):
     STARTING = "starting"
     READY = "ready"
     DEGRADED = "degraded"
+    RECOVERING = "recovering"
     DRAINING = "draining"
     STOPPED = "stopped"
 
@@ -166,6 +170,13 @@ class EngineScheduler:
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._spec_tpi_last: Optional[float] = None
+        # Self-healing aggregates (EngineSupervisor hooks): completed+attempted
+        # engine rebuilds, the in-progress attempt number (0 when healthy),
+        # and decode rows quarantined for numeric poison.
+        self._recoveries = 0
+        self._recovery_attempt = 0
+        self._last_recovery_reason: Optional[str] = None
+        self._quarantined = 0
         self._queue_weight = 0
         self._in_flight = 0
         self._state = ServerState.STARTING
@@ -237,6 +248,66 @@ class EngineScheduler:
             SPEC_EVENTS.record("spec.drafted", drafted)
         if accepted:
             SPEC_EVENTS.record("spec.accepted", accepted)
+
+    # -- self-healing (EngineSupervisor hooks) -----------------------------
+    def note_recovering(self, attempt: int, reason: str) -> None:
+        """The supervisor is tearing down and rebuilding the engine (attempt
+        N, bounded). Runs on the worker thread mid-launch; admission stays
+        open — queued work is served by the rebuilt engine."""
+        with self._cv:
+            self._recoveries += 1
+            self._recovery_attempt = attempt
+            self._last_recovery_reason = reason
+            if self._state in (ServerState.READY, ServerState.DEGRADED):
+                self._state = ServerState.RECOVERING
+        logger.warning(
+            "scheduler: engine RECOVERING (rebuild attempt %d, reason=%s)",
+            attempt,
+            reason,
+        )
+
+    def note_rebuilt(self) -> None:
+        """Engine rebuild succeeded; resume serving. Width backoff survives
+        the rebuild deliberately — an OOM-prone workload is still OOM-prone
+        on a fresh engine."""
+        with self._cv:
+            self._recovery_attempt = 0
+            if self._state is ServerState.RECOVERING:
+                self._state = (
+                    ServerState.DEGRADED if self._width_shift else ServerState.READY
+                )
+
+    def note_rebuild_failed(self, error: BaseException) -> None:
+        """Rebuild attempts exhausted (or the checkpoint reload failed):
+        terminal. Close admission and fail all queued work with a typed 503.
+        Runs on the worker thread, so no join here — the worker retires on
+        its own once it observes STOPPED with an empty queue."""
+        with self._cv:
+            self._state = ServerState.STOPPED
+            leftovers = [it for it in self._items if it is not None]
+            self._items.clear()
+            self._queue_weight = 0
+            self._shed += len(leftovers)
+            self._cv.notify_all()
+        # Futures complete outside the lock (callbacks may re-enter).
+        for it in leftovers:
+            if not it.future.done():
+                it.future.set_exception(
+                    BackendUnavailableError(
+                        f"engine stopped after exhausting rebuild attempts: {error}"
+                    )
+                )
+        if leftovers:
+            FAILURE_EVENTS.record("scheduler.shed_stopped", len(leftovers))
+        logger.error("scheduler: engine rebuild failed terminally: %s", error)
+
+    def note_quarantine(self, n: int) -> None:
+        """``n`` decode rows were quarantined for numeric poison (engine's
+        ``on_quarantine`` hook, forwarded by the backend)."""
+        if n <= 0:
+            return
+        with self._cv:
+            self._quarantined += n
 
     # -- worker -----------------------------------------------------------
     def _next_group(self) -> Optional[List[_Item]]:
@@ -652,6 +723,10 @@ class EngineScheduler:
                 "shed_over_capacity": self._shed_over_capacity,
                 "evicted": self._evicted,
                 "oom_splits": self._oom_splits,
+                "recoveries": self._recoveries,
+                "recovery_attempt": self._recovery_attempt,
+                "last_recovery_reason": self._last_recovery_reason,
+                "quarantined": self._quarantined,
                 "drain_rate": self._drain_rate(),
             }
 

@@ -12,13 +12,21 @@ backend needs weight loading only. Two formats:
 from __future__ import annotations
 
 import json
+import logging
 import os
+import zlib
 from typing import Any, Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .config import ModelConfig
+from ..reliability import failpoints as _failpoints
+from ..types.wire import CheckpointCorruptError
+from ..utils.observability import QUARANTINE_EVENTS
+
+logger = logging.getLogger(__name__)
 
 
 def _to_checkpoint_tree(tree: Any) -> Any:
@@ -40,6 +48,94 @@ def _to_checkpoint_tree(tree: Any) -> Any:
     return tree
 
 
+def param_summary(params: Any) -> Dict[str, Any]:
+    """Operator-facing weight identity: total bytes, dtype histogram (leaf
+    counts), and a content checksum (crc32 over path + bytes of every leaf,
+    in deterministic pytree order). Computed once at load time on the host
+    copies and surfaced through ``health()`` so operators can verify WHICH
+    weights are actually serving — and the supervisor can prove a rebuilt
+    engine reloaded identical ones."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    total = 0
+    hist: Dict[str, int] = {}
+    crc = 0
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        total += arr.nbytes
+        key = str(arr.dtype)
+        hist[key] = hist.get(key, 0) + 1
+        crc = zlib.crc32(jax.tree_util.keystr(path).encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return {
+        "total_bytes": total,
+        "num_leaves": len(leaves),
+        "dtype_histogram": hist,
+        "checksum": f"{crc & 0xFFFFFFFF:08x}",
+    }
+
+
+def _manifest_path(path: str) -> str:
+    # SIBLING of the checkpoint dir, not inside it: orbax owns the dir's
+    # layout and an extra file would trip its structure validation.
+    return os.path.abspath(path).rstrip("/") + ".params.json"
+
+
+def verify_param_integrity(
+    params: Any, manifest: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """Fail-fast weight verification at load time. Two layers:
+
+    1. Every float leaf must be fully finite — a bit-flipped or truncated
+       checkpoint shows up as NaN/Inf and would otherwise poison every decode.
+    2. When a save-time manifest exists, the recomputed summary's checksum
+       must match the recorded one (bytes-exact identity).
+
+    Raises the typed :class:`CheckpointCorruptError` (HTTP 500, code
+    ``checkpoint_corrupt``) on either failure; serving garbage weights is
+    strictly worse than refusing to start. Returns the computed summary so
+    callers don't pay a second full pass."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind != "f" or arr.size == 0:
+            continue
+        try:
+            finite = bool(np.isfinite(arr).all())
+        except TypeError:  # numpy without direct ufunc support for the dtype
+            finite = bool(np.isfinite(arr.astype(np.float32)).all())
+        if not finite:
+            QUARANTINE_EVENTS.record("quarantine.checksum_failures")
+            raise CheckpointCorruptError(
+                f"checkpoint leaf {jax.tree_util.keystr(path)} contains "
+                "non-finite values; refusing to serve corrupted weights"
+            )
+    summary = param_summary(params)
+    if manifest is not None and manifest.get("checksum") not in (
+        None,
+        summary["checksum"],
+    ):
+        QUARANTINE_EVENTS.record("quarantine.checksum_failures")
+        raise CheckpointCorruptError(
+            f"checkpoint checksum mismatch: loaded {summary['checksum']}, "
+            f"manifest records {manifest['checksum']}"
+        )
+    return summary
+
+
+def _corrupt_params(params: Any) -> Any:
+    """``loader.params=corrupt`` failpoint: overwrite the leading values of
+    the first float leaf with NaN, simulating the bit-rot a real corrupted
+    checkpoint exhibits, so ``verify_param_integrity`` must trip."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and arr.size:
+            bad = np.array(arr)
+            bad.reshape(-1)[: min(16, bad.size)] = np.nan
+            leaves[i] = bad
+            break
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def save_checkpoint(path: str, params: Dict[str, Any]) -> None:
     import orbax.checkpoint as ocp
 
@@ -47,6 +143,13 @@ def save_checkpoint(path: str, params: Dict[str, Any]) -> None:
     checkpointer = ocp.StandardCheckpointer()
     checkpointer.save(path, _to_checkpoint_tree(params))
     checkpointer.wait_until_finished()
+    # Integrity manifest (best-effort: a read-only destination must not fail
+    # the save): load_checkpoint verifies its checksum on restore.
+    try:
+        with open(_manifest_path(path), "w") as f:
+            json.dump(param_summary(params), f)
+    except OSError:
+        logger.warning("could not write param manifest next to %s", path, exc_info=True)
 
 
 def load_orbax(path: str) -> Dict[str, Any]:
@@ -188,10 +291,29 @@ def load_safetensors(path: str, config: ModelConfig, dtype=None) -> Dict[str, An
 
 
 def load_checkpoint(path: str, config: ModelConfig, dtype=None) -> Dict[str, Any]:
-    """Dispatch on content: safetensors dir vs orbax dir."""
+    """Dispatch on content: safetensors dir vs orbax dir. Every load runs
+    integrity verification (finite floats + manifest checksum when one was
+    written at save time) and fails fast with a typed
+    :class:`CheckpointCorruptError` rather than serving garbage weights."""
     if os.path.isdir(path) and any(f.endswith(".safetensors") for f in os.listdir(path)):
-        return load_safetensors(path, config, dtype)
-    return load_orbax(path)
+        params = load_safetensors(path, config, dtype)
+    else:
+        params = load_orbax(path)
+    fp = _failpoints.fire("loader.params")
+    if fp is not None and fp.action == "corrupt":
+        params = _corrupt_params(params)
+    manifest = None
+    if os.path.exists(_manifest_path(path)):
+        with open(_manifest_path(path)) as f:
+            manifest = json.load(f)
+    global last_load_summary
+    last_load_summary = verify_param_integrity(params, manifest)
+    return params
+
+
+#: Summary of the most recent successful load_checkpoint, for backends to
+#: surface through ``health()`` without re-hashing the whole tree.
+last_load_summary: Optional[Dict[str, Any]] = None
 
 
 def _rope_scaling_from_hf(rs: Optional[dict]):

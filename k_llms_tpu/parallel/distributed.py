@@ -37,6 +37,7 @@ def initialize_multihost(
     if coordinator_address is None and num_processes is None:
         return False  # single host
 
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -48,6 +49,24 @@ def initialize_multihost(
         jax.process_count(),
     )
     return True
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process collectives on the CPU backend need an explicit CPU
+    collectives implementation (gloo over TCP) — the default CPU client
+    refuses cross-process computations outright. TPU/GPU have native
+    collectives and never consult this flag, so only flip it when the
+    selected platform is CPU. Must run before the backend initializes, hence
+    the env/config sniff instead of jax.default_backend()."""
+    platforms = os.getenv("JAX_PLATFORMS") or str(
+        getattr(jax.config, "jax_platforms", None) or ""
+    )
+    if "cpu" not in platforms.lower().split(","):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # jax build without the flag or the gloo impl
+        logger.debug("CPU collectives implementation not configurable", exc_info=True)
 
 
 def _int_env(name: str) -> Optional[int]:

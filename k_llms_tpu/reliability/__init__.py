@@ -11,11 +11,14 @@ from . import failpoints
 from .deadline import Deadline, RequestBudget
 from .failpoints import FailSpec, failpoints as failpoint_scope
 from .retry import CircuitBreaker, RetryPolicy, is_retryable
+from .supervisor import EngineSupervisor, LaunchBudgetModel
 
 __all__ = [
     "CircuitBreaker",
     "Deadline",
+    "EngineSupervisor",
     "FailSpec",
+    "LaunchBudgetModel",
     "RequestBudget",
     "RetryPolicy",
     "failpoint_scope",

@@ -15,6 +15,13 @@ Injection sites wired in this package:
 - ``engine.decode``      — evaluated per request around the decode loop;
                            ``kill_samples`` marks a seeded subset of the n
                            samples as lost mid-decode
+- ``engine.logits``      — evaluated once per launch before the decode loop;
+                           the ``nan`` action poisons a seeded subset of the
+                           batch rows' first-step logits, exercising the
+                           numeric-integrity quarantine
+- ``loader.params``      — evaluated inside ``load_checkpoint``; ``corrupt``
+                           flips bytes in a loaded float leaf so integrity
+                           verification must fail fast
 - ``backend.dispatch``   — evaluated per dispatch attempt (retry/circuit path)
 - ``consensus.consolidate`` — evaluated at consolidation entry
 
@@ -25,8 +32,17 @@ Actions (``FailSpec.action``):
                        what jax surfaces on device HBM exhaustion, so the
                        engine's OOM guard (not generic error handling) catches
 - ``"sleep"``        — block ``delay`` seconds (deadline-expiry simulation)
+- ``"hang"``         — block ``delay`` seconds (default effectively forever);
+                       distinct from ``sleep`` so a hung-launch spec reads as
+                       what it simulates and defaults to "never returns",
+                       which is what the launch watchdog must survive
 - ``"kill_samples"`` — no-op at the site itself; the engine reads ``kill`` and
                        ``seed`` and marks that many samples failed
+- ``"nan"``          — no-op at the site itself; the engine reads ``kill``
+                       (row count) and ``seed`` and poisons that many batch
+                       rows' logits with NaN
+- ``"corrupt"``      — no-op at the site itself; the loader flips bytes in a
+                       param leaf after load so checksum verification trips
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -35,8 +51,10 @@ then recovers" retry tests are scripted.
 Env syntax (comma-separated):
     KLLMS_FAILPOINTS="backend.dispatch=raise:2,engine.decode=kill_samples:3:7"
     KLLMS_FAILPOINTS="engine.launch=oom:1"
-where the first numeric arg is ``times`` for raise/sleep/oom specs and
-``kill[:seed]`` for kill_samples.
+    KLLMS_FAILPOINTS="engine.launch=hang:1:30,engine.logits=nan:2:7"
+    KLLMS_FAILPOINTS="loader.params=corrupt:1"
+where the first numeric arg is ``times`` for raise/sleep/oom/corrupt specs,
+``times[:delay]`` for hang, and ``kill[:seed]`` for kill_samples/nan.
 """
 
 from __future__ import annotations
@@ -56,9 +74,16 @@ SITES = (
     "scheduler.admit",
     "engine.launch",
     "engine.decode",
+    "engine.logits",
+    "loader.params",
     "backend.dispatch",
     "consensus.consolidate",
 )
+
+#: Default "hang" duration: long enough that a watchdog MUST intervene for the
+#: test to finish, short enough that a leaked spec can't wedge a CI job past
+#: its own timeout.
+HANG_DELAY = 3600.0
 
 
 def _injected_oom() -> BaseException:
@@ -73,19 +98,30 @@ def _injected_oom() -> BaseException:
 
 @dataclass
 class FailSpec:
-    action: str = "raise"  # "raise" | "oom" | "sleep" | "kill_samples"
+    # "raise" | "oom" | "sleep" | "hang" | "kill_samples" | "nan" | "corrupt"
+    action: str = "raise"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
     )
     times: Optional[int] = None  # fire at most N times; None = every time
-    delay: float = 0.0  # for action="sleep"
-    kill: int = 0  # for action="kill_samples": how many samples to mark lost
-    seed: int = 0  # deterministic sample-kill selection
+    delay: float = 0.0  # for action="sleep"/"hang" (hang defaults to HANG_DELAY)
+    kill: int = 0  # kill_samples: samples to mark lost; nan: rows to poison
+    seed: int = 0  # deterministic sample-kill / row-poison selection
     _fired: int = field(default=0, init=False, repr=False)
 
     def __post_init__(self) -> None:
-        if self.action not in ("raise", "oom", "sleep", "kill_samples"):
+        if self.action not in (
+            "raise",
+            "oom",
+            "sleep",
+            "hang",
+            "kill_samples",
+            "nan",
+            "corrupt",
+        ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
+        if self.action == "hang" and self.delay <= 0:
+            self.delay = HANG_DELAY
 
 
 _lock = threading.Lock()
@@ -115,10 +151,10 @@ def fire(site: str) -> Optional[FailSpec]:
         raise spec.error_factory()
     if spec.action == "oom":
         raise _injected_oom()
-    if spec.action == "sleep":
+    if spec.action in ("sleep", "hang"):
         time.sleep(spec.delay)
         return None
-    return spec  # kill_samples: the site's owner interprets kill/seed
+    return spec  # kill_samples/nan/corrupt: the site's owner interprets it
 
 
 @contextlib.contextmanager
@@ -158,17 +194,21 @@ def configure_from_env(env: Optional[str] = None) -> None:
             continue
         site, _, rhs = part.partition("=")
         action, *args = rhs.split(":")
-        if action == "kill_samples":
+        if action in ("kill_samples", "nan"):
             kill = int(args[0]) if args else 1
             seed = int(args[1]) if len(args) > 1 else 0
-            specs[site] = FailSpec(action="kill_samples", kill=kill, seed=seed)
+            specs[site] = FailSpec(action=action, kill=kill, seed=seed)
         elif action == "sleep":
             delay = float(args[0]) if args else 0.1
             times = int(args[1]) if len(args) > 1 else None
             specs[site] = FailSpec(action="sleep", delay=delay, times=times)
-        elif action == "oom":
+        elif action == "hang":
+            times = int(args[0]) if args else 1
+            delay = float(args[1]) if len(args) > 1 else HANG_DELAY
+            specs[site] = FailSpec(action="hang", times=times, delay=delay)
+        elif action in ("oom", "corrupt"):
             times = int(args[0]) if args else None
-            specs[site] = FailSpec(action="oom", times=times)
+            specs[site] = FailSpec(action=action, times=times)
         else:
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action="raise", times=times)

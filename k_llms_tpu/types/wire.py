@@ -188,6 +188,27 @@ class BackendUnavailableError(KLLMsError):
     status_code = 503
 
 
+class EngineHungError(BackendUnavailableError):
+    """A device launch exceeded its wall-clock watchdog budget and was
+    declared hung. The supervisor replays the work on a rebuilt engine, so
+    callers normally never see this; it surfaces only when rebuild attempts
+    are exhausted (then as the terminal member error). Subclasses
+    BackendUnavailableError so every existing 503/breaker/retry treatment of
+    an unavailable backend applies unchanged."""
+
+    code = "engine_hung"
+
+
+class CheckpointCorruptError(KLLMsError):
+    """Weight integrity verification failed at load time: the checkpoint's
+    bytes do not match its recorded checksums. Fail-fast and non-retryable —
+    serving garbage weights is strictly worse than refusing to start."""
+
+    type = "server_error"
+    code = "checkpoint_corrupt"
+    status_code = 500
+
+
 class RateLimitError(KLLMsError):
     """Admission shed: the scheduler's queue is at its weight cap and this
     request was rejected instead of queued unboundedly (openai.RateLimitError's

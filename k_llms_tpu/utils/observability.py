@@ -99,6 +99,19 @@ FAILURE_EVENTS = EventCounters()
 #: fleet-level acceptance rate operators tune spec_lookahead against.
 SPEC_EVENTS = EventCounters()
 
+#: Process-wide self-healing counters (supervisor.hung_launches,
+#: supervisor.rebuilds, supervisor.rebuild_failures, supervisor.replayed,
+#: supervisor.stale_results_discarded), fed by the EngineSupervisor. A nonzero
+#: rebuild count on a healthy fleet is the "devices are flaking" alarm.
+RECOVERY_EVENTS = EventCounters()
+
+#: Process-wide numeric-integrity counters (quarantine.samples — decode rows
+#: quarantined for NaN/Inf/degenerate logits, quarantine.launches — launches
+#: with at least one poisoned row, quarantine.checksum_failures — corrupted
+#: checkpoints rejected at load). Poison on a healthy fleet means bad HBM or a
+#: bad checkpoint, not bad luck.
+QUARANTINE_EVENTS = EventCounters()
+
 
 def _walk_confidences(node: Any, out: List[float]) -> None:
     if isinstance(node, dict):

@@ -1,0 +1,41 @@
+"""Static failpoint inventory (PR 4 satellite): every site registered in
+``k_llms_tpu.reliability.failpoints.SITES`` must be exercised by at least one
+test, by literal name, somewhere in the test tree. A registered-but-untested
+site is dead injection surface — it suggests a hardened path that nothing
+pins, which is exactly how fault-handling code rots."""
+
+import pathlib
+
+from k_llms_tpu.reliability.failpoints import SITES
+
+TESTS_DIR = pathlib.Path(__file__).parent
+THIS_FILE = pathlib.Path(__file__).name
+
+
+def _test_tree_text():
+    """Concatenated source of every test module except this one (which names
+    every site by construction and must not self-satisfy the check)."""
+    chunks = []
+    for path in sorted(TESTS_DIR.rglob("test_*.py")):
+        if path.name == THIS_FILE:
+            continue
+        chunks.append(path.read_text(encoding="utf-8"))
+    return "\n".join(chunks)
+
+
+def test_every_registered_failpoint_is_exercised():
+    tree = _test_tree_text()
+    unexercised = [site for site in SITES if site not in tree]
+    assert not unexercised, (
+        f"failpoint site(s) {unexercised} are registered in failpoints.SITES "
+        "but no test names them — add coverage or retire the site"
+    )
+
+
+def test_inventory_is_nonempty_and_names_are_registered():
+    """Guard the guard: SITES is the single source of truth and stays
+    dot-namespaced (subsystem.site), so grep hits are unambiguous."""
+    assert len(SITES) >= 7
+    for site in SITES:
+        sub, _, name = site.partition(".")
+        assert sub and name, f"site {site!r} must be subsystem.name"

@@ -1,0 +1,142 @@
+"""health() snapshots across every ServerState transition (PR 4 satellite):
+each reachable lifecycle state yields a well-typed snapshot — state string,
+queue depth, recovery attempt counters, quarantine counters — so a /healthz
+consumer never sees a missing or mistyped field mid-transition."""
+
+import pytest
+
+from k_llms_tpu.engine.scheduler import EngineScheduler, ServerState
+from k_llms_tpu.types.wire import BackendUnavailableError
+
+INT_FIELDS = (
+    "queue_depth",
+    "queue_weight",
+    "in_flight",
+    "effective_max_rows",
+    "max_rows",
+    "served",
+    "errors",
+    "shed",
+    "shed_over_capacity",
+    "evicted",
+    "oom_splits",
+    "recoveries",
+    "recovery_attempt",
+    "quarantined",
+)
+
+
+def _assert_snapshot_shape(h):
+    assert h["state"] in {s.value for s in ServerState}
+    for k in INT_FIELDS:
+        assert isinstance(h[k], int), f"{k} must be an int, got {type(h[k])}"
+    assert h["last_recovery_reason"] is None or isinstance(
+        h["last_recovery_reason"], str
+    )
+    assert h["max_queue_weight"] is None or isinstance(h["max_queue_weight"], int)
+    assert isinstance(h["drain_rate"], (int, float))
+
+
+def test_health_through_full_lifecycle():
+    """Walk READY -> DEGRADED -> RECOVERING -> DEGRADED -> READY ->
+    DRAINING/STOPPED via the same hooks the engine and supervisor use,
+    asserting snapshot shape and the recovery/quarantine fields at each
+    step."""
+    s = EngineScheduler(name="lifecycle", max_rows=8)
+    try:
+        h = s.health()
+        # STARTING is transient (worker thread startup); both are legal here.
+        assert h["state"] in ("starting", "ready")
+        _assert_snapshot_shape(h)
+        assert h["recoveries"] == 0 and h["recovery_attempt"] == 0
+        assert h["last_recovery_reason"] is None and h["quarantined"] == 0
+
+        # Device OOM: width backs off, DEGRADED.
+        s.note_oom()
+        h = s.health()
+        assert h["state"] == "degraded"
+        assert h["effective_max_rows"] == 4 and h["oom_splits"] == 1
+        _assert_snapshot_shape(h)
+
+        # Supervisor starts a rebuild: RECOVERING, attempt visible.
+        s.note_recovering(1, "hung_launch")
+        h = s.health()
+        assert h["state"] == "recovering"
+        assert h["recoveries"] == 1 and h["recovery_attempt"] == 1
+        assert h["last_recovery_reason"] == "hung_launch"
+        _assert_snapshot_shape(h)
+
+        # Quarantined rows accumulate regardless of lifecycle state.
+        s.note_quarantine(3)
+        s.note_quarantine(0)  # no-op
+        assert s.health()["quarantined"] == 3
+
+        # Rebuild done: width backoff SURVIVES the rebuild, so the scheduler
+        # lands back in DEGRADED, not READY.
+        s.note_rebuilt()
+        h = s.health()
+        assert h["state"] == "degraded" and h["recovery_attempt"] == 0
+        _assert_snapshot_shape(h)
+
+        # Three clean launches restore the width and clear DEGRADED.
+        for _ in range(3):
+            s.note_recovered()
+        h = s.health()
+        assert h["state"] == "ready" and h["effective_max_rows"] == 8
+        _assert_snapshot_shape(h)
+
+        # A second recovery from READY also transitions.
+        s.note_recovering(1, "poison_rate")
+        h = s.health()
+        assert h["state"] == "recovering" and h["recoveries"] == 2
+        assert h["last_recovery_reason"] == "poison_rate"
+        s.note_rebuilt()
+        assert s.health()["state"] == "ready"  # no width backoff this time
+    finally:
+        assert s.drain(timeout=5.0)
+    h = s.health()
+    assert h["state"] == "stopped"
+    _assert_snapshot_shape(h)
+
+
+def test_health_during_draining_state():
+    """DRAINING is observable mid-drain: admission closed, snapshot intact."""
+    import threading
+    import time
+
+    s = EngineScheduler(name="drainer", batch_window=0.0)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow(_):
+        entered.set()
+        release.set()  # trivial work; drain() below must still join cleanly
+        return 1
+
+    s.call(lambda: slow(None))
+    t = threading.Thread(target=lambda: s.drain(timeout=5.0))
+    t.start()
+    # Poll until the drain thread flips the state (scheduler may already have
+    # finished the queued work, so accept stopped too).
+    for _ in range(100):
+        if s.health()["state"] in ("draining", "stopped"):
+            break
+        time.sleep(0.01)
+    h = s.health()
+    assert h["state"] in ("draining", "stopped")
+    _assert_snapshot_shape(h)
+    t.join(timeout=10.0)
+    assert s.health()["state"] == "stopped"
+
+
+def test_rebuild_failed_stops_and_flushes_queue_typed():
+    """Terminal rebuild failure: STOPPED, queued futures flushed with a typed
+    503, snapshot still well-formed, new work rejected."""
+    s = EngineScheduler(name="terminal")
+    s.note_rebuild_failed(RuntimeError("rebuild exploded"))
+    h = s.health()
+    assert h["state"] == "stopped"
+    _assert_snapshot_shape(h)
+    with pytest.raises(BackendUnavailableError) as ei:
+        s.call(lambda: 1)
+    assert ei.value.status_code == 503

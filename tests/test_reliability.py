@@ -285,6 +285,70 @@ def test_failpoint_env_parsing():
     assert not fp.active()
 
 
+def test_failpoint_hang_env_parsing_defaults():
+    fp.configure_from_env("engine.launch=hang:1:30,engine.logits=nan:2:7,loader.params=corrupt:1")
+    try:
+        hang = fp._registry["engine.launch"]
+        assert hang.action == "hang" and hang.times == 1 and hang.delay == 30.0
+        nan = fp._registry["engine.logits"]
+        assert nan.action == "nan" and nan.kill == 2 and nan.seed == 7
+        assert fp._registry["loader.params"].action == "corrupt"
+    finally:
+        fp.clear()
+    # A bare hang spec defaults to "effectively forever" — the watchdog, not
+    # the spec, must be what unwedges the launch.
+    fp.configure_from_env("engine.launch=hang")
+    try:
+        assert fp._registry["engine.launch"].delay == fp.HANG_DELAY
+    finally:
+        fp.clear()
+
+
+def test_failpoint_scheduler_admit_raises_at_submission():
+    """The scheduler.admit site fires at submit time, BEFORE any queueing —
+    the injected fault reaches the caller synchronously."""
+    from k_llms_tpu.engine.scheduler import EngineScheduler
+
+    s = EngineScheduler(name="admit-fp")
+    try:
+        with fp.failpoints({"scheduler.admit": FailSpec(action="raise", times=1)}):
+            with pytest.raises(RuntimeError, match="injected failpoint fault"):
+                s.call(lambda: 1)
+        assert s.call(lambda: 2) == 2  # spec consumed; admission healthy again
+    finally:
+        s.drain(timeout=5.0)
+
+
+def test_failpoint_consensus_consolidate_raises():
+    """The consensus.consolidate site fires at consolidation entry, after
+    generation — a consolidation fault must not be mistaken for a backend
+    fault (no breaker/retry involvement)."""
+    from k_llms_tpu.consensus.consolidation import consolidate_chat_completions
+    from k_llms_tpu.consensus.similarity import SimilarityScorer
+    from k_llms_tpu.types import ChatCompletion
+
+    completion = ChatCompletion.model_validate(
+        {
+            "id": "cc-1",
+            "object": "chat.completion",
+            "created": 0,
+            "model": "tiny",
+            "choices": [
+                {
+                    "index": 0,
+                    "finish_reason": "stop",
+                    "message": {"role": "assistant", "content": "hi"},
+                }
+            ],
+        }
+    )
+    scorer = SimilarityScorer.levenshtein()
+    with fp.failpoints({"consensus.consolidate": FailSpec(action="raise", times=1)}):
+        with pytest.raises(RuntimeError, match="injected failpoint fault"):
+            consolidate_chat_completions([completion], scorer)
+    consolidate_chat_completions([completion], scorer)  # healthy after the scope
+
+
 # -- failure-event counters -----------------------------------------------
 
 

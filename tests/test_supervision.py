@@ -1,0 +1,506 @@
+"""Self-healing engine supervision (PR 4): hung-launch watchdog, crash
+recovery with in-flight replay, and the numeric-integrity quarantine.
+
+Unit coverage of the supervisor primitives (budget model, epoch fencing,
+bounded rebuilds, poison escalation), loader integrity (param summary,
+manifest round-trip, corrupt-checkpoint fail-fast), engine-level quarantine
+(NaN rows excluded from the consensus vote, healthy rows untouched), and the
+ISSUE acceptance scenarios end to end on the real CPU engine: a hung launch
+heals transparently (request resolves, scheduler returns READY), a replayed
+request is byte-identical to an uninterrupted run, and the slow-tagged chaos
+soak proves the stack never wedges under hang + NaN faults mid-traffic.
+"""
+
+import re
+import threading
+import time
+
+import pytest
+
+from k_llms_tpu import KLLMs
+from k_llms_tpu.reliability import failpoints as fp
+from k_llms_tpu.reliability.failpoints import FailSpec
+from k_llms_tpu.reliability.supervisor import EngineSupervisor, LaunchBudgetModel
+from k_llms_tpu.types.wire import (
+    BackendUnavailableError,
+    CheckpointCorruptError,
+    EngineHungError,
+    KLLMsError,
+)
+from k_llms_tpu.utils.observability import QUARANTINE_EVENTS, RECOVERY_EVENTS
+
+
+# -- LaunchBudgetModel ----------------------------------------------------
+
+
+def test_launch_budget_model_clamps_and_learns():
+    m = LaunchBudgetModel(
+        base_s=1.0, per_token_s=0.5, multiplier=2.0, min_budget_s=5.0, max_budget_s=50.0
+    )
+    assert m.budget(4, 1) == 5.0  # floor absorbs compile time
+    assert m.budget(4, 1000) == 50.0  # ceiling bounds worst-case wait
+    # First observation replaces the prior outright (no slow warm-up from a
+    # guessed per-token latency), later ones EWMA toward the new sample.
+    m.observe(4, 100, 10.0)
+    assert m.stats()["per_token_s"] == pytest.approx(0.1)
+    m.observe(4, 100, 30.0)
+    assert 0.1 < m.stats()["per_token_s"] < 0.3
+    assert m.stats()["observed_launches"] == 2
+
+
+def _tight_budget() -> LaunchBudgetModel:
+    """Watchdog fires after 0.25 s — unit tests simulate a hang by sleeping
+    past that on the launch thread."""
+    return LaunchBudgetModel(
+        base_s=0.05, per_token_s=0.01, multiplier=1.0,
+        min_budget_s=0.25, max_budget_s=0.25,
+    )
+
+
+# -- EngineSupervisor (fake launch/rebuild fns) ---------------------------
+
+
+def test_hang_is_healed_by_rebuild_and_replay():
+    calls = {"launch": 0, "rebuild": 0}
+    events = []
+
+    def rebuild():
+        calls["rebuild"] += 1
+
+    sup = EngineSupervisor(
+        rebuild_fn=rebuild,
+        budget_model=_tight_budget(),
+        max_rebuilds=2,
+        on_recovering=lambda a, r: events.append(("recovering", a, r)),
+        on_rebuilt=lambda: events.append(("rebuilt",)),
+    )
+
+    def launch():
+        calls["launch"] += 1
+        if calls["launch"] == 1:
+            time.sleep(1.0)  # wedged first attempt
+        return "ok"
+
+    assert sup.supervised_launch(launch, rows=2, max_new_tokens=4) == "ok"
+    assert calls == {"launch": 2, "rebuild": 1}
+    assert events == [("recovering", 1, "hung_launch"), ("rebuilt",)]
+    st = sup.stats()
+    assert st["hung_launches"] == 1 and st["rebuilds"] == 1
+    assert st["replayed"] == 2  # rows, not launches
+    assert st["epoch"] == 1 and st["consecutive_rebuilds"] == 0
+    assert st["last_rebuild_reason"] == "hung_launch" and not st["stopped"]
+
+
+def test_stale_result_from_hung_launch_is_discarded():
+    """Epoch fencing: the abandoned thread's late result is discarded, never
+    raced against the replay — the idempotency half of replay semantics."""
+    before = RECOVERY_EVENTS.snapshot().get("supervisor.stale_results_discarded", 0)
+    calls = {"n": 0}
+    sup = EngineSupervisor(rebuild_fn=lambda: None, budget_model=_tight_budget())
+
+    def launch():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(0.6)
+            return "stale"
+        return "fresh"
+
+    assert sup.supervised_launch(launch) == "fresh"
+    time.sleep(0.8)  # let the abandoned thread complete and hit the fence
+    after = RECOVERY_EVENTS.snapshot().get("supervisor.stale_results_discarded", 0)
+    # >= not ==: abandoned threads leaked by NEIGHBORING tests may also land
+    # their (correctly discarded) stale results inside this window.
+    assert after >= before + 1
+    assert sup.epoch == 1
+
+
+def test_rebuild_exhaustion_is_terminal_and_sticky():
+    failed = []
+    sup = EngineSupervisor(
+        rebuild_fn=lambda: None,
+        budget_model=_tight_budget(),
+        max_rebuilds=1,
+        on_rebuild_failed=failed.append,
+    )
+    with pytest.raises(EngineHungError, match="did not recover after 1"):
+        sup.supervised_launch(lambda: time.sleep(1.0))
+    assert len(failed) == 1 and isinstance(failed[0], EngineHungError)
+    assert sup.stats()["stopped"]
+    # Sticky: later launches fail fast without touching the engine.
+    with pytest.raises(EngineHungError, match="stopped"):
+        sup.supervised_launch(lambda: "never reached")
+
+
+def test_corrupt_reload_is_terminal_with_typed_error():
+    """A corrupt checkpoint can never be healed by retrying the rebuild —
+    the precise typed error surfaces instead of a generic hung error."""
+    def bad_rebuild():
+        raise CheckpointCorruptError("manifest checksum mismatch")
+
+    sup = EngineSupervisor(rebuild_fn=bad_rebuild, budget_model=_tight_budget())
+    with pytest.raises(CheckpointCorruptError, match="checksum"):
+        sup.supervised_launch(lambda: time.sleep(1.0))
+    assert sup.stats()["stopped"]
+
+
+def test_poison_rate_escalates_to_rebuild():
+    rebuilds = []
+    sup = EngineSupervisor(
+        rebuild_fn=lambda: rebuilds.append(1),
+        budget_model=_tight_budget(),
+        poison_threshold=0.5,
+        poison_window=4,
+    )
+    sup.note_poison(0, 4)  # clean launch decays the window
+    sup.note_poison(1, 4)  # aggregate 1/8 < 0.5: below threshold
+    assert sup.supervised_launch(lambda: "ok") == "ok"
+    assert not rebuilds
+    sup.note_poison(4, 4)
+    sup.note_poison(4, 4)  # aggregate 9/16 >= 0.5: escalate
+    assert sup.supervised_launch(lambda: "ok") == "ok"
+    assert len(rebuilds) == 1
+    assert sup.stats()["last_rebuild_reason"] == "poison_rate"
+    # The escalation consumed the poison history; no rebuild storm.
+    assert sup.supervised_launch(lambda: "ok") == "ok"
+    assert len(rebuilds) == 1
+
+
+def test_launch_exception_propagates_without_rebuild():
+    """A launch that FAILS (raises) is not a launch that HANGS — errors keep
+    their existing typed paths (OOM guard, breaker) and must not trigger the
+    supervisor."""
+    rebuilds = []
+    sup = EngineSupervisor(rebuild_fn=lambda: rebuilds.append(1), budget_model=_tight_budget())
+
+    def launch():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        sup.supervised_launch(launch)
+    assert not rebuilds
+    st = sup.stats()
+    assert st["rebuilds"] == 0 and not st["stopped"]
+
+
+# -- loader integrity ------------------------------------------------------
+
+
+def test_param_summary_shape():
+    import jax
+
+    from k_llms_tpu.models import get_config, init_params
+    from k_llms_tpu.models.loader import param_summary
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    s = param_summary(params)
+    assert s["total_bytes"] > 0 and s["num_leaves"] >= 1
+    assert sum(s["dtype_histogram"].values()) == s["num_leaves"]
+    assert re.fullmatch(r"[0-9a-f]{8}", s["checksum"])
+    assert param_summary(params) == s  # deterministic
+
+
+def test_checkpoint_manifest_roundtrip_and_tamper(tmp_path):
+    import json
+
+    import jax
+
+    from k_llms_tpu.models import get_config, init_params
+    from k_llms_tpu.models import loader
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    path = str(tmp_path / "ckpt")
+    loader.save_checkpoint(path, params)
+    manifest_path = loader._manifest_path(path)
+    assert manifest_path.endswith(".params.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    assert manifest["checksum"] == loader.param_summary(params)["checksum"]
+
+    loader.load_checkpoint(path, cfg)  # clean load verifies against manifest
+    assert loader.last_load_summary["checksum"] == manifest["checksum"]
+    assert loader.last_load_summary["total_bytes"] == manifest["total_bytes"]
+
+    manifest["checksum"] = "deadbeef"
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(CheckpointCorruptError, match="checksum"):
+        loader.load_checkpoint(path, cfg)
+
+
+def test_corrupt_failpoint_fails_fast(tmp_path):
+    import jax
+
+    from k_llms_tpu.models import get_config, init_params
+    from k_llms_tpu.models import loader
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    path = str(tmp_path / "ckpt")
+    loader.save_checkpoint(path, params)
+    before = QUARANTINE_EVENTS.snapshot().get("quarantine.checksum_failures", 0)
+    with fp.failpoints({"loader.params": FailSpec(action="corrupt", times=1)}):
+        with pytest.raises(CheckpointCorruptError, match="non-finite"):
+            loader.load_checkpoint(path, cfg)
+    assert QUARANTINE_EVENTS.snapshot()["quarantine.checksum_failures"] == before + 1
+    # The failpoint consumed its budget; the checkpoint itself is intact.
+    loader.load_checkpoint(path, cfg)
+
+
+def test_param_summary_surfaces_in_backend_health(tmp_path):
+    """Satellite: operators can verify WHICH weights are serving — the
+    loader's verified summary rides health()["params"] when a checkpoint is
+    loaded, and is None for seeded params."""
+    import jax
+
+    from k_llms_tpu.backends.tpu import TpuBackend
+    from k_llms_tpu.models import get_config, init_params
+    from k_llms_tpu.models import loader
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    path = str(tmp_path / "ckpt")
+    loader.save_checkpoint(path, params)
+    b = TpuBackend(model="tiny", checkpoint_path=path)
+    try:
+        summary = b.health()["params"]
+        assert summary["checksum"] == loader.param_summary(params)["checksum"]
+        assert summary["total_bytes"] > 0 and summary["dtype_histogram"]
+    finally:
+        b.close()
+    b2 = TpuBackend(model="tiny")
+    try:
+        assert b2.health()["params"] is None
+    finally:
+        b2.close()
+
+
+# -- numeric-integrity quarantine on the real engine ----------------------
+
+
+@pytest.fixture(scope="module")
+def tpu_client():
+    return KLLMs(backend="tpu", model="tiny", max_new_tokens=16)
+
+
+def test_nan_quarantine_degrades_to_survivor_consensus(tpu_client):
+    """ISSUE acceptance: NaN logits on 2 of n=6 decode rows quarantine ONLY
+    the poisoned samples — survivors still vote, the degraded marker breaks
+    the losses down by code, and likelihoods scale by survival."""
+    with fp.failpoints({"engine.logits": FailSpec(action="nan", kill=2, seed=3)}):
+        resp = tpu_client.chat.completions.create(
+            messages=[{"role": "user", "content": "report"}],
+            model="tiny",
+            n=6,
+            temperature=0.0,
+            seed=5,
+        )
+    assert len(resp.choices) == 7  # consensus + 6 originals
+    quarantined = [c for c in resp.choices[1:] if getattr(c, "sample_error", None)]
+    survivors = [c for c in resp.choices[1:] if not getattr(c, "sample_error", None)]
+    assert len(quarantined) == 2 and len(survivors) == 4
+    assert all(c.sample_error["code"] == "numeric_poison" for c in quarantined)
+    assert all(c.message.content == "" for c in quarantined)
+    # consensus from survivors (greedy: all four agree)
+    assert resp.choices[0].message.content == survivors[0].message.content
+    assert resp.choices[0].message.content != ""
+    assert resp.degraded["requested"] == 6 and resp.degraded["survived"] == 4
+    assert resp.degraded["error_codes"] == {"numeric_poison": 2}
+    assert resp.likelihoods == {"text": pytest.approx(4 / 6)}
+    # engine-level counters surfaced through health()
+    h = tpu_client.health()
+    assert h["quarantine"]["samples"] >= 2 and h["quarantine"]["launches"] >= 1
+    assert h["quarantined"] >= 2
+
+
+def test_clean_traffic_decays_poison_window(tpu_client):
+    """Healthy launches report poisoned=0 so the escalation window decays —
+    one bad launch among many clean ones never triggers a rebuild."""
+    sup = tpu_client.backend.supervisor
+    rebuilds_before = sup.stats()["rebuilds"]
+    resp = tpu_client.chat.completions.create(
+        messages=[{"role": "user", "content": "clean"}], model="tiny", n=2, seed=1
+    )
+    assert resp.degraded is None
+    assert sup.stats()["rebuilds"] == rebuilds_before
+    assert len(sup._poison_history) >= 1
+    assert sup._poison_history[-1][0] == 0  # clean launch recorded as 0 poisoned
+
+
+@pytest.fixture(scope="module")
+def spec_client():
+    """Speculative decoding enabled: the spec decode loop has its own
+    quarantine path (poisoned rows get a zero verify budget and emit
+    nothing)."""
+    return KLLMs(
+        backend="tpu", model="tiny", max_new_tokens=12, speculative="prompt_lookup"
+    )
+
+
+def test_nan_quarantine_speculative_path(spec_client):
+    with fp.failpoints({"engine.logits": FailSpec(action="nan", kill=1, seed=0)}):
+        resp = spec_client.chat.completions.create(
+            messages=[{"role": "user", "content": "echo echo echo"}],
+            model="tiny",
+            n=3,
+            temperature=0.0,
+            seed=2,
+        )
+    quarantined = [c for c in resp.choices[1:] if getattr(c, "sample_error", None)]
+    assert len(quarantined) == 1
+    assert quarantined[0].sample_error["code"] == "numeric_poison"
+    assert quarantined[0].message.content == ""
+    assert resp.degraded["survived"] == 2
+
+
+# -- watchdog + recovery end to end on the real engine --------------------
+
+
+def _tight_backend(**kw):
+    from k_llms_tpu.backends.tpu import TpuBackend
+
+    kw.setdefault("watchdog_base_s", 0.5)
+    kw.setdefault("watchdog_per_token_s", 0.01)
+    kw.setdefault("watchdog_multiplier", 1.0)
+    kw.setdefault("watchdog_min_budget_s", 2.0)
+    return TpuBackend(model="tiny", **kw)
+
+
+def _chat_req(n=1, max_tokens=4, seed=1, temperature=0.0, content="hi"):
+    from k_llms_tpu.backends.base import ChatRequest
+
+    return ChatRequest(
+        model="tiny",
+        messages=[{"role": "user", "content": content}],
+        n=n,
+        max_tokens=max_tokens,
+        temperature=temperature,
+        seed=seed,
+    )
+
+
+@pytest.mark.duration_budget(30)
+def test_hung_launch_end_to_end_recovery():
+    """ISSUE acceptance: with engine.launch=hang:1 the request still resolves
+    (watchdog detaches, engine rebuilds, launch replays) and the scheduler
+    returns to READY with the recovery visible in health()."""
+    before = RECOVERY_EVENTS.snapshot().get("supervisor.hung_launches", 0)
+    with fp.failpoints({"engine.launch": FailSpec(action="hang", times=1, delay=10.0)}):
+        b = _tight_backend()
+        try:
+            cc = b.chat_completion(_chat_req())
+            assert len(cc.choices) == 1
+            assert cc.choices[0].finish_reason in ("length", "stop")
+            h = b.health()
+            assert h["state"] == "ready"
+            assert h["supervisor"]["hung_launches"] == 1
+            assert h["supervisor"]["rebuilds"] == 1
+            assert h["supervisor"]["replayed"] >= 1
+            assert h["supervisor"]["consecutive_rebuilds"] == 0
+            assert h["recoveries"] == 1 and h["recovery_attempt"] == 0
+            assert h["last_recovery_reason"] == "hung_launch"
+        finally:
+            b.close()
+    assert RECOVERY_EVENTS.snapshot()["supervisor.hung_launches"] == before + 1
+
+
+@pytest.mark.duration_budget(30)
+def test_replay_is_byte_identical_to_uninterrupted_run():
+    """ISSUE acceptance: seeds are pinned at submission, weights reload to
+    the same values (same param_seed), so the replayed request's text is
+    byte-identical to a run that never hung."""
+    kwargs = dict(n=2, max_tokens=8, seed=123, temperature=1.0, content="determinism")
+    b1 = _tight_backend()
+    try:
+        baseline = b1.chat_completion(_chat_req(**kwargs))
+    finally:
+        b1.close()
+    with fp.failpoints({"engine.launch": FailSpec(action="hang", times=1, delay=10.0)}):
+        b2 = _tight_backend()
+        try:
+            replayed = b2.chat_completion(_chat_req(**kwargs))
+            assert b2.supervisor.stats()["hung_launches"] == 1  # the hang happened
+        finally:
+            b2.close()
+    assert [c.message.content for c in replayed.choices] == [
+        c.message.content for c in baseline.choices
+    ]
+    assert replayed.usage.completion_tokens == baseline.usage.completion_tokens
+
+
+@pytest.mark.duration_budget(30)
+def test_rebuild_exhaustion_stops_scheduler_with_typed_503():
+    """Every launch hangs; bounded rebuilds exhaust; the scheduler goes
+    STOPPED and subsequent requests fail fast with a typed 503."""
+    with fp.failpoints({"engine.launch": FailSpec(action="hang", times=10, delay=10.0)}):
+        b = _tight_backend(max_rebuilds=1, watchdog_min_budget_s=1.0)
+        try:
+            with pytest.raises(EngineHungError, match="did not recover"):
+                b.chat_completion(_chat_req(max_tokens=2))
+            h = b.health()
+            assert h["state"] == "stopped"
+            assert h["supervisor"]["stopped"]
+            with pytest.raises(BackendUnavailableError) as ei:
+                b.chat_completion(_chat_req(max_tokens=2))
+            assert ei.value.status_code == 503
+        finally:
+            b.close()
+
+
+@pytest.mark.slow
+@pytest.mark.duration_budget(180)
+def test_chaos_soak_hang_and_nan_mid_traffic():
+    """ISSUE acceptance chaos soak: a hung launch AND NaN poison injected
+    under concurrent traffic. Every request resolves (success, degraded, or
+    typed error), zero hung futures, rebuilds stay bounded, and the engine
+    returns to READY for clean traffic afterwards."""
+    # Budget 8 s: far below the 30 s hang (the watchdog MUST fire) but roomy
+    # enough that a post-rebuild replay — full recompile + a 32-row coalesced
+    # decode — finishes inside it even on a loaded CI machine. A too-tight
+    # budget would declare the legitimate replay hung and exhaust rebuilds.
+    b = _tight_backend(poison_threshold=0.9, watchdog_min_budget_s=8.0)
+    # poison_threshold=0.9: quarantine absorbs the NaNs; the hang is what
+    # exercises rebuild here.
+    results = {}
+    lock = threading.Lock()
+
+    def worker(i):
+        try:
+            cc = b.chat_completion(
+                _chat_req(n=4, max_tokens=6, seed=100 + i, content=f"soak {i}")
+            )
+            with lock:
+                results[i] = ("ok", cc)
+        except KLLMsError as e:
+            with lock:
+                results[i] = ("typed", e)
+
+    with fp.failpoints(
+        {
+            "engine.launch": FailSpec(action="hang", times=1, delay=30.0),
+            "engine.logits": FailSpec(action="nan", kill=1, seed=9),
+        }
+    ):
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90.0)
+        # Zero hung futures: every worker thread completed.
+        assert not any(t.is_alive() for t in threads)
+    assert sorted(results) == list(range(8))
+    oks = [r for r in results.values() if r[0] == "ok"]
+    assert oks, "at least some requests must succeed through the recovery"
+    for kind, payload in results.values():
+        if kind == "ok":
+            assert len(payload.choices) == 4
+    h = b.health()
+    assert h["supervisor"]["rebuilds"] <= b.backend_config.max_rebuilds + 1
+    assert h["supervisor"]["hung_launches"] >= 1
+    assert h["quarantine"]["samples"] >= 1  # NaNs were quarantined, not fatal
+    # Clean traffic after the chaos: engine healed back to READY.
+    cc = b.chat_completion(_chat_req(n=2, max_tokens=4, seed=7))
+    assert len(cc.choices) == 2
+    assert b.health()["state"] in ("ready", "degraded")
+    b.close()
