@@ -455,6 +455,65 @@ def bench_host_consensus() -> dict:
     }
 
 
+def bench_hedging() -> dict:
+    """Tail-latency rescue via replica hedging (hermetic — FakeBackend
+    members, no device): a 2-member replica set where one member is made slow
+    through the keyed ``replica.dispatch`` sleep failpoint. Round-robin
+    routing pins half the primaries onto the slow member (health routing
+    would learn to avoid it and hide the effect), so with hedging OFF the
+    p99 — and here even the p50 — carries the injected stall, while with
+    hedging ON the duplicate dispatch on the healthy member rescues the tail
+    at roughly the hedge delay."""
+    from k_llms_tpu.backends.base import ChatRequest
+    from k_llms_tpu.backends.fake import FakeBackend
+    from k_llms_tpu.reliability import failpoints as fp
+    from k_llms_tpu.reliability.failpoints import FailSpec
+    from k_llms_tpu.reliability.replicas import ReplicaSet
+
+    slow_s, hedge_delay_s, requests = 0.060, 0.015, 40
+
+    def quantile(xs: list, q: float) -> float:
+        ordered = sorted(xs)
+        return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1)))]
+
+    def run(hedge: bool) -> dict:
+        rs = ReplicaSet(
+            members=[FakeBackend(["hedged"]), FakeBackend(["hedged"])],
+            model="fake",
+            hedge=hedge,
+            hedge_delay_s=hedge_delay_s,
+            route_policy="round_robin",
+        )
+        request = ChatRequest(
+            messages=[{"role": "user", "content": "bench"}], model="fake"
+        )
+        latencies = []
+        with fp.failpoints(
+            {"replica.dispatch": FailSpec(action="sleep", member="r1", delay=slow_s)}
+        ):
+            for _ in range(requests):
+                t0 = time.perf_counter()
+                rs.dispatch_chat_completion(request)
+                latencies.append((time.perf_counter() - t0) * 1000.0)
+        stats = rs.stats()
+        rs._executor.shutdown(wait=False)
+        return {
+            "p50_ms": round(quantile(latencies, 0.50), 2),
+            "p99_ms": round(quantile(latencies, 0.99), 2),
+            "hedges_won": sum(s["hedges_won"] for s in stats.values()),
+        }
+
+    off, on = run(False), run(True)
+    return {
+        "requests": requests,
+        "slow_member_stall_ms": slow_s * 1000.0,
+        "hedge_delay_ms": hedge_delay_s * 1000.0,
+        "hedging_off": off,
+        "hedging_on": on,
+        "p99_speedup_x": round(off["p99_ms"] / max(on["p99_ms"], 1e-6), 2),
+    }
+
+
 def _emit(value, vs_baseline, detail: dict, error: "str | None" = None) -> None:
     line = {
         "metric": "n32_consensus_p50_over_single_p50",
@@ -478,6 +537,10 @@ def main() -> None:
         detail["host_consensus"] = bench_host_consensus()
     except Exception as exc:
         detail["host_consensus"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        detail["hedging"] = bench_hedging()
+    except Exception as exc:  # hermetic like quality; a failure here is a bug
+        detail["hedging"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     last_error = None
     for attempt in range(1, RUN_RETRIES + 2):

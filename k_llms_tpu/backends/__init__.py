@@ -4,11 +4,32 @@ The reference's "model layer" is the remote OpenAI HTTP API
 (`/root/reference/k_llms/resources/completions/completions.py:73,134`). Here it is
 a pluggable :class:`Backend`: ``tpu`` (local JAX/XLA engine), ``fake``
 (deterministic scripted completions for hermetic tests — the fixture layer the
-reference never shipped, SURVEY.md §4), and ``openai`` (HTTP passthrough when the
-``openai`` package is installed).
+reference never shipped, SURVEY.md §4), ``openai`` (HTTP passthrough when the
+``openai`` package is installed), and ``replicas`` (a
+:class:`~k_llms_tpu.reliability.replicas.ReplicaSet` of member backends with
+health-aware routing, failover, and hedging).
 """
 
-from .base import Backend, ChatRequest, resolve_backend
+from typing import Any
+
+from .base import Backend, ChatRequest, UnknownBackendError, resolve_backend
 from .fake import FakeBackend
 
-__all__ = ["Backend", "ChatRequest", "FakeBackend", "resolve_backend"]
+__all__ = [
+    "Backend",
+    "ChatRequest",
+    "FakeBackend",
+    "ReplicaSet",
+    "UnknownBackendError",
+    "resolve_backend",
+]
+
+
+def __getattr__(name: str) -> Any:
+    # Lazy: replicas.py imports this package (via backends.base), so a
+    # top-level import here would be circular.
+    if name == "ReplicaSet":
+        from ..reliability.replicas import ReplicaSet
+
+        return ReplicaSet
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -186,16 +186,52 @@ class Backend(abc.ABC):
         pass
 
 
+class UnknownBackendError(ValueError):
+    """``resolve_backend`` got a name (or object) it cannot turn into a
+    Backend. Subclasses ValueError so pre-existing ``except ValueError``
+    callers keep working; carries the offending value and the known names so
+    the message is actionable instead of a bare failure."""
+
+    def __init__(self, backend: Any, known: List[str]):
+        self.backend = backend
+        self.known = list(known)
+        shown = ", ".join(repr(k) for k in self.known)
+        super().__init__(
+            f"Unknown backend {backend!r}; expected one of {shown} "
+            "(a name, case-insensitive), or a Backend instance"
+        )
+
+
+#: Accepted backend names (case/whitespace-insensitive) → canonical family.
+_BACKEND_ALIASES: Dict[str, str] = {
+    "fake": "fake",
+    "tpu": "tpu",
+    "jax": "tpu",
+    "local": "tpu",
+    "openai": "openai",
+    "replicas": "replicas",
+    "replica": "replicas",
+    "replicaset": "replicas",
+    "replica_set": "replicas",
+}
+
+
 def resolve_backend(backend: Union[str, Backend, None], **kwargs: Any) -> Backend:
-    """Instantiate a backend from a name ("tpu" | "fake" | "openai") or pass one through."""
+    """Instantiate a backend from a name ("tpu" | "fake" | "openai" |
+    "replicas", plus aliases; None defaults to "tpu") or pass a Backend
+    instance through unchanged. Unknown names raise
+    :class:`UnknownBackendError` listing what would have been accepted."""
     if isinstance(backend, Backend):
         return backend
-    name = (backend or "tpu").lower()
+    known = sorted(_BACKEND_ALIASES)
+    if backend is not None and not isinstance(backend, str):
+        raise UnknownBackendError(backend, known)
+    name = _BACKEND_ALIASES.get((backend or "tpu").strip().lower())
     if name == "fake":
         from .fake import FakeBackend
 
         return FakeBackend(**kwargs)
-    if name == "tpu" or name == "jax" or name == "local":
+    if name == "tpu":
         from .tpu import TpuBackend
 
         return TpuBackend(**kwargs)
@@ -203,4 +239,8 @@ def resolve_backend(backend: Union[str, Backend, None], **kwargs: Any) -> Backen
         from .openai_backend import OpenAIBackend
 
         return OpenAIBackend(**kwargs)
-    raise ValueError(f"Unknown backend {backend!r}; expected 'tpu', 'fake', or 'openai'")
+    if name == "replicas":
+        from ..reliability.replicas import ReplicaSet
+
+        return ReplicaSet(**kwargs)
+    raise UnknownBackendError(backend, known)

@@ -5,7 +5,11 @@ Parity target: `/root/reference/k_llms/client.py` — ``KLLMs`` :31-44,
 helper with token cropping :75-122. The OpenAI client inside becomes a pluggable
 backend: ``KLLMs(backend="tpu", model="llama-3-8b")`` runs everything locally on
 the device mesh; ``backend="fake"`` is the hermetic test double;
-``backend="openai"`` reproduces the reference's HTTP flow.
+``backend="openai"`` reproduces the reference's HTTP flow; and
+``KLLMs(backend="replicas", members=[...])`` serves from a
+:class:`~k_llms_tpu.reliability.replicas.ReplicaSet` — N member engines with
+health-aware routing, mid-flight failover, and hedged dispatch — behind the
+same client surface.
 """
 
 from __future__ import annotations

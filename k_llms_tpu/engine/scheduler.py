@@ -177,6 +177,13 @@ class EngineScheduler:
         self._recovery_attempt = 0
         self._last_recovery_reason: Optional[str] = None
         self._quarantined = 0
+        # Replica-set aggregates (ReplicaSet hooks): launches routed to this
+        # member, failovers it absorbed for a sick sibling, and hedge
+        # launches/wins it served.
+        self._routed = 0
+        self._failovers = 0
+        self._hedges = 0
+        self._hedges_won = 0
         self._queue_weight = 0
         self._in_flight = 0
         self._state = ServerState.STARTING
@@ -308,6 +315,26 @@ class EngineScheduler:
             return
         with self._cv:
             self._quarantined += n
+
+    # -- replica routing (ReplicaSet hooks) --------------------------------
+    def note_routed(self) -> None:
+        """A ReplicaSet routed a launch to this member (primary dispatch)."""
+        with self._cv:
+            self._routed += 1
+
+    def note_failover(self) -> None:
+        """This member absorbed a mid-flight failover from a sick sibling."""
+        with self._cv:
+            self._failovers += 1
+
+    def note_hedge(self, won: bool = False) -> None:
+        """A hedged duplicate launched on this member; ``won=True`` records
+        separately that the hedge finished first (tail rescue)."""
+        with self._cv:
+            if won:
+                self._hedges_won += 1
+            else:
+                self._hedges += 1
 
     # -- worker -----------------------------------------------------------
     def _next_group(self) -> Optional[List[_Item]]:
@@ -703,6 +730,10 @@ class EngineScheduler:
                 "spec_drafted": self._spec_drafted,
                 "spec_accepted": self._spec_accepted,
                 "spec_tokens_per_iteration": self._spec_tpi_last,
+                "routed": self._routed,
+                "failovers": self._failovers,
+                "hedges": self._hedges,
+                "hedges_won": self._hedges_won,
             }
 
     def health(self) -> Dict[str, Any]:
@@ -727,6 +758,10 @@ class EngineScheduler:
                 "recovery_attempt": self._recovery_attempt,
                 "last_recovery_reason": self._last_recovery_reason,
                 "quarantined": self._quarantined,
+                "routed": self._routed,
+                "failovers": self._failovers,
+                "hedges": self._hedges,
+                "hedges_won": self._hedges_won,
                 "drain_rate": self._drain_rate(),
             }
 

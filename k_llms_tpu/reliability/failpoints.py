@@ -24,6 +24,14 @@ Injection sites wired in this package:
                            verification must fail fast
 - ``backend.dispatch``   — evaluated per dispatch attempt (retry/circuit path)
 - ``consensus.consolidate`` — evaluated at consolidation entry
+- ``replica.dispatch``   — evaluated (keyed by replica id) before every member
+                           dispatch of a :class:`ReplicaSet` — primary,
+                           failover, and hedge attempts alike; the ``down``
+                           action kills the attempt with a replica-health
+                           error so routing must fail over
+- ``replica.probe``      — evaluated (keyed by replica id) at the top of a
+                           replica health probe; ``fail`` keeps a pulled
+                           member out of rotation until the spec exhausts
 
 Actions (``FailSpec.action``):
 
@@ -43,6 +51,11 @@ Actions (``FailSpec.action``):
                        rows' logits with NaN
 - ``"corrupt"``      — no-op at the site itself; the loader flips bytes in a
                        param leaf after load so checksum verification trips
+- ``"down"``         — raise ``EngineHungError`` (a replica-health error) for
+                       the member named by ``member``; other members of the
+                       keyed site pass through without consuming ``times``
+- ``"fail"``         — raise RuntimeError for the member named by ``member``
+                       (generic probe/dispatch failure, keyed like ``down``)
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -53,8 +66,10 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="engine.launch=oom:1"
     KLLMS_FAILPOINTS="engine.launch=hang:1:30,engine.logits=nan:2:7"
     KLLMS_FAILPOINTS="loader.params=corrupt:1"
+    KLLMS_FAILPOINTS="replica.dispatch=down:r1:2,replica.probe=fail:r1:1"
 where the first numeric arg is ``times`` for raise/sleep/oom/corrupt specs,
-``times[:delay]`` for hang, and ``kill[:seed]`` for kill_samples/nan.
+``times[:delay]`` for hang, ``kill[:seed]`` for kill_samples/nan, and
+``member[:times]`` for down/fail (replica sites are keyed by replica id).
 """
 
 from __future__ import annotations
@@ -78,6 +93,8 @@ SITES = (
     "loader.params",
     "backend.dispatch",
     "consensus.consolidate",
+    "replica.dispatch",
+    "replica.probe",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the
@@ -99,6 +116,7 @@ def _injected_oom() -> BaseException:
 @dataclass
 class FailSpec:
     # "raise" | "oom" | "sleep" | "hang" | "kill_samples" | "nan" | "corrupt"
+    # | "down" | "fail"
     action: str = "raise"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
@@ -107,6 +125,7 @@ class FailSpec:
     delay: float = 0.0  # for action="sleep"/"hang" (hang defaults to HANG_DELAY)
     kill: int = 0  # kill_samples: samples to mark lost; nan: rows to poison
     seed: int = 0  # deterministic sample-kill / row-poison selection
+    member: Optional[str] = None  # keyed sites: only fire for this replica id
     _fired: int = field(default=0, init=False, repr=False)
 
     def __post_init__(self) -> None:
@@ -118,6 +137,8 @@ class FailSpec:
             "kill_samples",
             "nan",
             "corrupt",
+            "down",
+            "fail",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -155,6 +176,44 @@ def fire(site: str) -> Optional[FailSpec]:
         time.sleep(spec.delay)
         return None
     return spec  # kill_samples/nan/corrupt: the site's owner interprets it
+
+
+def fire_keyed(site: str, key: str) -> Optional[FailSpec]:
+    """Evaluate a keyed site (the ``replica.*`` sites, keyed by replica id).
+
+    The spec applies only when its ``member`` is ``None`` or equals ``key``; a
+    non-matching member neither fires nor consumes ``times``, so
+    ``down:r1:2`` kills exactly two dispatches *on r1* regardless of how many
+    healthy-member dispatches are interleaved."""
+    if not _registry:
+        return None
+    with _lock:
+        spec = _registry.get(site)
+        if spec is None:
+            return None
+        if spec.member is not None and spec.member != key:
+            return None
+        if spec.times is not None:
+            if spec._fired >= spec.times:
+                return None
+            spec._fired += 1
+    logger.debug("failpoint %s fired for %s (%s)", site, key, spec.action)
+    if spec.action == "down":
+        # Lazy import: wire depends on nothing here, but keep this module
+        # import-light for the production no-op path.
+        from ..types.wire import EngineHungError
+
+        raise EngineHungError(f"injected replica fault (failpoint): member {key} is down")
+    if spec.action == "fail":
+        raise RuntimeError(f"injected replica fault (failpoint): member {key} failed")
+    if spec.action == "raise":
+        raise spec.error_factory()
+    if spec.action == "oom":
+        raise _injected_oom()
+    if spec.action in ("sleep", "hang"):
+        time.sleep(spec.delay)
+        return None
+    return spec
 
 
 @contextlib.contextmanager
@@ -209,6 +268,10 @@ def configure_from_env(env: Optional[str] = None) -> None:
         elif action in ("oom", "corrupt"):
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action=action, times=times)
+        elif action in ("down", "fail"):
+            member = args[0] if args and args[0] else None
+            times = int(args[1]) if len(args) > 1 else None
+            specs[site] = FailSpec(action=action, member=member, times=times)
         else:
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action="raise", times=times)

@@ -188,6 +188,25 @@ class BackendUnavailableError(KLLMsError):
     status_code = 503
 
 
+class NoHealthyReplicasError(BackendUnavailableError):
+    """Every member of a :class:`ReplicaSet` is out of rotation (breaker open,
+    supervisor RECOVERING, draining, or pulled after a dispatch failure) and no
+    probe could bring one back. ``reasons`` maps replica id → why that member
+    is unavailable, so the 503 body tells an operator which members to look at
+    rather than just that the set is down."""
+
+    code = "no_healthy_replicas"
+
+    def __init__(self, message: str, reasons: Optional[Dict[str, str]] = None):
+        super().__init__(message)
+        self.reasons = dict(reasons or {})
+
+    def as_wire(self) -> Dict[str, Any]:
+        body = super().as_wire()
+        body["error"]["replicas"] = dict(self.reasons)
+        return body
+
+
 class EngineHungError(BackendUnavailableError):
     """A device launch exceeded its wall-clock watchdog budget and was
     declared hung. The supervisor replays the work on a rebuilt engine, so

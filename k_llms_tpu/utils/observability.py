@@ -105,6 +105,22 @@ SPEC_EVENTS = EventCounters()
 #: rebuild count on a healthy fleet is the "devices are flaking" alarm.
 RECOVERY_EVENTS = EventCounters()
 
+#: Process-wide replica-routing counters (route.dispatched, route.pulled —
+#: members removed from rotation, route.probes / route.probe_failures /
+#: route.rejoins — probation lifecycle, route.no_healthy — requests that found
+#: zero eligible members), fed by the ReplicaSet router.
+ROUTE_EVENTS = EventCounters()
+
+#: Process-wide hedged-dispatch counters (hedge.launched, hedge.won_primary,
+#: hedge.won_hedge, hedge.cancelled_losers). hedge.won_hedge / hedge.launched
+#: is the rescue rate: how often duplicating the tail actually paid off.
+HEDGE_EVENTS = EventCounters()
+
+#: Process-wide mid-flight failover counters (failover.attempts,
+#: failover.member_down, failover.exhausted). Nonzero failover on a healthy
+#: fleet means a member is flapping faster than its probes rejoin it.
+FAILOVER_EVENTS = EventCounters()
+
 #: Process-wide numeric-integrity counters (quarantine.samples — decode rows
 #: quarantined for NaN/Inf/degenerate logits, quarantine.launches — launches
 #: with at least one poisoned row, quarantine.checksum_failures — corrupted
