@@ -455,6 +455,176 @@ def bench_host_consensus() -> dict:
     }
 
 
+def bench_serving() -> dict:
+    """Hermetic serving workload (PR 6): a loopback HTTP server (stdlib
+    runner, ServerThread) over the tiny CPU backend, driven with httpx —
+    the serving stack end to end, no device required.
+
+    Two headline numbers:
+
+    - TTFT: p50 time-to-first-SSE-delta for stream=true vs the p50 full
+      response latency of the same request non-streamed. Streaming's reason
+      to exist is that the first token arrives a decode-step in, not a full
+      consensus later.
+    - Occupancy under staggered load: the same 6-request trickle (arrivals
+      mid-decode of earlier requests) through (a) the continuous in-flight
+      slot loop and (b) the coalescing scheduler. Occupancy = useful row-
+      steps / (serving width W * sequential device steps): late arrivals can
+      JOIN the continuous batch, so its device steps carry more live rows;
+      the coalesced path decodes each straggler as its own launch.
+    """
+    import httpx
+
+    from k_llms_tpu import KLLMs
+    from k_llms_tpu.backends.tpu import TpuBackend
+    from k_llms_tpu.serving import ServerThread, ServingApp
+
+    # Stagger chosen well inside one request's decode time (tiny model on
+    # CPU decodes ~0.1s at 48 tokens), so later arrivals genuinely land
+    # mid-decode of earlier ones — the case the slot loop exists for.
+    W, N_PER, MAX_TOK = 4, 2, 48
+    N_REQ, STAGGER_S = 6, 0.01
+    msgs = [{"role": "user", "content": "Stream me a short answer."}]
+
+    def make_client(continuous: bool) -> KLLMs:
+        backend = TpuBackend(
+            model="tiny", max_new_tokens=MAX_TOK, batch_window=0.0,
+            continuous_batching=continuous, continuous_width=W,
+            continuous_max_prompt=128, continuous_max_new=64,
+        )
+        return KLLMs(backend=backend, model="tiny")
+
+    out: dict = {
+        "width": W, "requests": N_REQ, "n_per_request": N_PER,
+        "max_tokens": MAX_TOK, "stagger_s": STAGGER_S,
+    }
+
+    # -- TTFT vs non-stream p50 (continuous backend, loopback socket) ------
+    client = make_client(continuous=True)
+    with ServerThread(ServingApp(client)) as srv:
+        url = srv.base_url + "/v1/chat/completions"
+
+        def body(seed: int, stream: bool) -> dict:
+            return {
+                "messages": msgs, "model": "tiny", "n": N_PER,
+                "max_tokens": MAX_TOK, "temperature": 0.8, "seed": seed,
+                "stream": stream,
+            }
+
+        httpx.post(url, json=body(0, False), timeout=600)  # warm compiles
+        ttfts, fulls = [], []
+        for i in range(5):
+            t0 = time.perf_counter()
+            with httpx.stream("POST", url, json=body(10 + i, True), timeout=600) as r:
+                frames = r.iter_raw()
+                next(frames, None)
+                ttfts.append(time.perf_counter() - t0)
+                for _ in frames:
+                    pass
+            t0 = time.perf_counter()
+            httpx.post(url, json=body(10 + i, False), timeout=600)
+            fulls.append(time.perf_counter() - t0)
+        ttft_p50 = statistics.median(ttfts)
+        full_p50 = statistics.median(fulls)
+        out["ttft_stream_p50_s"] = round(ttft_p50, 4)
+        out["nonstream_p50_s"] = round(full_p50, 4)
+        out["ttft_speedup"] = round(full_p50 / ttft_p50, 2)
+
+        # -- staggered occupancy: continuous --------------------------------
+        loop = client.backend._continuous
+        steps0, rows0 = loop.stats["steps"], loop.stats["row_steps"]
+
+        def fire(seed: int) -> None:
+            httpx.post(url, json=body(seed, False), timeout=600)
+
+        threads = [
+            threading.Thread(target=fire, args=(100 + i,)) for i in range(N_REQ)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+            time.sleep(STAGGER_S)
+        for t in threads:
+            t.join()
+        cont_makespan = time.perf_counter() - t0
+        steps = loop.stats["steps"] - steps0
+        row_steps = loop.stats["row_steps"] - rows0
+        out["continuous"] = {
+            "occupancy": round(row_steps / max(1, steps * W), 4),
+            "device_steps": steps,
+            "row_steps": row_steps,
+            "joined_in_flight": loop.stats["joined_in_flight"],
+            "makespan_s": round(cont_makespan, 4),
+        }
+    client.backend.close()
+
+    # -- staggered occupancy: coalesced baseline ---------------------------
+    client2 = make_client(continuous=False)
+    engine2 = client2.backend.engine
+    launches: list = []
+    orig_many = engine2.generate_many
+
+    def counted_many(specs, **kw):
+        # One entry per LAUNCH (a coalesced group decodes together, so its
+        # device steps are the longest member's, not the sum).
+        results = orig_many(specs, **kw)
+        lens = [
+            int(x)
+            for res in results
+            if res is not None and getattr(res, "lengths", None) is not None
+            for x in res.lengths
+        ]
+        if lens:
+            launches.append((sum(lens), max(lens)))
+        return results
+
+    engine2.generate_many = counted_many
+    with ServerThread(ServingApp(client2)) as srv2:
+        url2 = srv2.base_url + "/v1/chat/completions"
+        httpx.post(
+            url2,
+            json={"messages": msgs, "model": "tiny", "n": N_PER,
+                  "max_tokens": MAX_TOK, "temperature": 0.8, "seed": 0},
+            timeout=600,
+        )  # warm
+        launches.clear()
+
+        def fire2(seed: int) -> None:
+            httpx.post(
+                url2,
+                json={"messages": msgs, "model": "tiny", "n": N_PER,
+                      "max_tokens": MAX_TOK, "temperature": 0.8, "seed": seed},
+                timeout=600,
+            )
+
+        threads = [
+            threading.Thread(target=fire2, args=(100 + i,)) for i in range(N_REQ)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+            time.sleep(STAGGER_S)
+        for t in threads:
+            t.join()
+        coal_makespan = time.perf_counter() - t0
+    client2.backend.close()
+    # Sequential device steps at serving width W: each launch runs
+    # max(lengths) steps with its own (small) row count; useful row-steps are
+    # the tokens actually produced.
+    useful = sum(tokens for tokens, _ in launches)
+    total_steps = sum(steps for _, steps in launches)
+    out["coalesced"] = {
+        "occupancy": round(useful / max(1, total_steps * W), 4),
+        "launches": len(launches),
+        "device_steps": total_steps,
+        "makespan_s": round(coal_makespan, 4),
+    }
+    out["occupancy_gain"] = round(
+        out["continuous"]["occupancy"] / max(1e-9, out["coalesced"]["occupancy"]), 3
+    )
+    return out
+
+
 def bench_hedging() -> dict:
     """Tail-latency rescue via replica hedging (hermetic — FakeBackend
     members, no device): a 2-member replica set where one member is made slow
@@ -541,6 +711,10 @@ def main() -> None:
         detail["hedging"] = bench_hedging()
     except Exception as exc:  # hermetic like quality; a failure here is a bug
         detail["hedging"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        detail["serving"] = bench_serving()
+    except Exception as exc:  # hermetic like quality; a failure here is a bug
+        detail["serving"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     last_error = None
     for attempt in range(1, RUN_RETRIES + 2):

@@ -5,7 +5,7 @@ from __future__ import annotations
 import abc
 import threading
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Any, Dict, List, Optional, Union
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Union
 
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
@@ -51,6 +51,63 @@ class Backend(abc.ABC):
     @abc.abstractmethod
     def chat_completion(self, request: ChatRequest) -> ChatCompletion:
         """Return ONE ChatCompletion carrying n choices (the n samples)."""
+
+    #: True when ``chat_completion_stream`` delivers incremental deltas. The
+    #: resources layer checks this before opening a stream so ``stream=True``
+    #: against a non-streaming backend fails as a typed 400 up front rather
+    #: than deep in dispatch.
+    supports_streaming: bool = False
+
+    def chat_completion_stream(
+        self, request: ChatRequest, emit: "Callable[[int, str], None]"
+    ) -> ChatCompletion:
+        """Run one n-way completion, calling ``emit(sample_idx, text_delta)``
+        as sample text lands (sample_idx in 0..n-1, request order), then
+        return the finished ChatCompletion exactly as ``chat_completion``
+        would. Backends that cannot stream raise the OpenAI-shaped 400."""
+        from ..types.wire import InvalidRequestError
+
+        raise InvalidRequestError(
+            f"{type(self).__name__} does not support stream=True; "
+            "use a streaming-capable backend (tpu, fake) or stream=False",
+            param="stream",
+        )
+
+    def dispatch_chat_completion_stream(
+        self, request: ChatRequest, emit: "Callable[[int, str], None]"
+    ) -> ChatCompletion:
+        """``chat_completion_stream`` behind the circuit-breaker gate and the
+        ``backend.dispatch`` failpoint. Deliberately NOT retried: once deltas
+        have reached the client a retry would replay text mid-stream, so a
+        stream gets exactly one attempt and surfaces its fault."""
+        from ..types.wire import (
+            RateLimitError,
+            RequestCancelledError,
+            RequestTimeoutError,
+            ServerDrainingError,
+        )
+
+        breaker = self.circuit_breaker
+        breaker.allow()
+        try:
+            _failpoints.fire("backend.dispatch")
+            out = self.chat_completion_stream(request, emit)
+        except BaseException as e:
+            # Same exemptions as the non-stream path: caller deadlines/cancels
+            # and admission sheds are not backend-health signals.
+            if not isinstance(
+                e,
+                (
+                    RequestTimeoutError,
+                    RequestCancelledError,
+                    RateLimitError,
+                    ServerDrainingError,
+                ),
+            ):
+                breaker.record_failure()
+            raise
+        breaker.record_success()
+        return out
 
     #: Dispatch-layer reliability knobs, overridable per instance (pass a
     #: seeded RetryPolicy in tests to pin backoff schedules). The breaker is

@@ -12,6 +12,7 @@ import hashlib
 import itertools
 import time
 from collections import Counter
+import re
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -72,6 +73,25 @@ class FakeBackend(Backend):
             "",
         )
         return [str(last_user) for _ in range(n)]
+
+    supports_streaming = True
+
+    def chat_completion_stream(
+        self, request: ChatRequest, emit: Callable[[int, str], None]
+    ) -> ChatCompletion:
+        """Deterministic streaming: build the full completion, then replay each
+        sample's content as word-sized deltas (whitespace kept) so SSE tests
+        see multiple chunks per sample without any timing dependence."""
+        completion = self.chat_completion(request)
+        for i, choice in enumerate(completion.choices):
+            content = choice.message.content or ""
+            # Always at least one delta per sample, even for empty content —
+            # the wire contract tests pin ">=1 delta before the final event".
+            for delta in re.findall(r"\S+\s*|\s+", content) or [""]:
+                if request.budget is not None:
+                    request.budget.check("stream")
+                emit(i, delta)
+        return completion
 
     def chat_completion(self, request: ChatRequest) -> ChatCompletion:
         contents = self._contents_for(request)

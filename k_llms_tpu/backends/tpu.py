@@ -152,6 +152,20 @@ class BackendConfig(BaseModel):
     # the engine (reload weights, fresh compile).
     poison_threshold: float = 0.5
     poison_window: int = 8
+    # -- continuous in-flight batching (PR 6) -----------------------------
+    # Persistent decode loop with slot admission (engine/continuous.py):
+    # requests join/leave a fixed-width decode batch mid-flight instead of
+    # waiting for coalesced groups to finish — the serving path's streaming
+    # and tail-latency mode. Requests needing constraints, top_logprobs,
+    # penalties, or logit_bias still take the coalescing scheduler.
+    continuous_batching: bool = False
+    # Slot count (decode batch width). Clamped by the HBM memory model's
+    # row cap at (continuous_max_prompt + continuous_max_new) KV per slot.
+    continuous_width: int = 8
+    # Per-slot KV bounds; longer prompts / larger max_tokens fall back to
+    # the coalescing path.
+    continuous_max_prompt: int = 512
+    continuous_max_new: int = 256
 
 
 def _detect_hbm_bytes() -> Optional[int]:
@@ -240,6 +254,77 @@ class HbmMemoryModel:
             "dp": self.dp,
             "max_rows_at_max_seq": self.max_rows(self.config.max_seq_len),
         }
+
+
+class _IncrementalDetok:
+    """Turns per-step token taps into per-sample TEXT deltas for SSE.
+
+    Byte/BPE decodes are not prefix-stable token by token: a cut inside a
+    multi-byte UTF-8 character decodes to U+FFFD, and HF-style decode cleanup
+    can rewrite earlier characters when a token is appended. So each feed
+    re-decodes the sample's full accumulated ids, holds back any replacement-
+    character tail, and emits only a grown prefix extension — a step whose
+    decode shrank or diverged emits nothing and later steps recover. Stop
+    strings truncate here too (nothing past the earliest occurrence reaches
+    the wire), mirroring chat_completion's authoritative host-side scan.
+
+    ``flush_final`` reconciles against the finished choices: samples that
+    never produced a delta (speculative decode and SP-prefix paths have no
+    token tap) get their full text as one delta — the wire contract is at
+    least one delta per live sample before the final consensus event.
+    """
+
+    def __init__(self, tok, n: int, pad_id: int, stop_strings: List[str],
+                 emit) -> None:
+        self.tok = tok
+        self.n = n
+        self.pad_id = pad_id
+        self.stop_strings = stop_strings
+        self.emit = emit
+        self.ids: List[List[int]] = [[] for _ in range(n)]
+        self.sent: List[str] = ["" for _ in range(n)]
+        self.stopped = [False] * n
+
+    def feed(self, step: int, toks: np.ndarray) -> None:
+        for i in range(min(self.n, len(toks))):
+            t = int(toks[i])
+            if t == self.pad_id or self.stopped[i]:
+                continue
+            self.ids[i].append(t)
+            text = self.tok.decode(self.ids[i])
+            while text.endswith("�"):
+                # Incomplete UTF-8 tail — hold it back until the next token
+                # completes the character.
+                text = text[:-1]
+            cuts = [
+                pos for s in self.stop_strings if (pos := text.find(s)) != -1
+            ]
+            if cuts:
+                text = text[: min(cuts)]
+                self.stopped[i] = True
+            if len(text) > len(self.sent[i]) and text.startswith(self.sent[i]):
+                delta = text[len(self.sent[i]):]
+                self.sent[i] = text
+                self.emit(i, delta)
+
+    def flush_final(self, final_texts: List[Optional[str]]) -> None:
+        for i, final in enumerate(final_texts):
+            if final is None:
+                continue
+            sent = self.sent[i]
+            if not sent:
+                self.emit(i, final)
+            elif final.startswith(sent):
+                rest = final[len(sent):]
+                if rest:
+                    self.emit(i, rest)
+            elif final != sent:
+                # Streamed text diverged from the authoritative decode (decode
+                # cleanup rewrote earlier characters). The final consensus
+                # event carries the correct text; don't compound the drift.
+                logger.debug(
+                    "streamed text diverged from final decode for sample %d", i
+                )
 
 
 class TpuBackend(Backend):
@@ -349,6 +434,31 @@ class TpuBackend(Backend):
         self._wire_engine_hooks()
         self._closed = False
         self._dfa_cache: Dict[str, Any] = {}
+        # Continuous in-flight batching: a persistent slot-admission decode
+        # loop beside the coalescing scheduler. Admission respects the same
+        # DRAINING/STOPPED lifecycle (admission_gate) so drain() quiesces both.
+        self._continuous = None
+        if cfg.continuous_batching:
+            self._continuous = self._build_continuous_loop()
+
+    def _build_continuous_loop(self):
+        from ..engine.continuous import ContinuousDecodeLoop
+
+        cfg = self.backend_config
+        width = min(
+            cfg.continuous_width,
+            self.memory_model.max_rows(
+                cfg.continuous_max_prompt + cfg.continuous_max_new
+            ),
+        )
+        return ContinuousDecodeLoop(
+            self.engine,
+            width=max(1, width),
+            max_prompt=cfg.continuous_max_prompt,
+            max_new=cfg.continuous_max_new,
+            eos_ids=self.tokenizer.stop_ids,
+            admission_gate=self.scheduler.admission_error,
+        )
 
     # -- engine lifecycle --------------------------------------------------
     def _build_engine(self) -> LocalEngine:
@@ -406,9 +516,32 @@ class TpuBackend(Backend):
         releases them; explicit teardown would race that thread."""
         self.engine = self._build_engine()
         self._wire_engine_hooks()
+        if self._continuous is not None:
+            # The loop holds device KV tied to the wedged engine's params —
+            # fail its in-flight work (callers see the same typed 503 a
+            # mid-rebuild coalesced launch gets) and stand up a fresh loop
+            # bound to the new engine.
+            from ..types.wire import BackendUnavailableError
+
+            old = self._continuous
+            old._fail_all(
+                BackendUnavailableError(
+                    "engine rebuilt mid-decode; retry the request"
+                )
+            )
+            old.stop()
+            self._continuous = self._build_continuous_loop()
 
     # -- chat -------------------------------------------------------------
-    def chat_completion(self, request: ChatRequest) -> ChatCompletion:
+    supports_streaming = True
+
+    def chat_completion_stream(self, request: ChatRequest, emit) -> ChatCompletion:
+        """Streaming wire contract: per-token text deltas via ``emit(i, text)``
+        while the decode runs, then the full ChatCompletion for consolidation.
+        Same generation as chat_completion — only the tap differs."""
+        return self.chat_completion(request, _token_emit=emit)
+
+    def chat_completion(self, request: ChatRequest, _token_emit=None) -> ChatCompletion:
         tok = self.tokenizer
         prompt_ids = tok.apply_chat_template(request.messages, add_generation_prompt=True)
         n = max(1, request.n)
@@ -453,6 +586,13 @@ class TpuBackend(Backend):
             if 0 < len(ids_s) <= MAX_STOP_LEN
         ][:MAX_STOP_SEQS] or None
 
+        detok = None
+        if _token_emit is not None:
+            detok = _IncrementalDetok(
+                tok, n, self.engine.config.pad_token_id, stop_strings,
+                _token_emit,
+            )
+
         result = self._generate_batched(
             prompt_ids,
             n=n,
@@ -467,9 +607,11 @@ class TpuBackend(Backend):
             logit_bias=logit_bias,
             stop_sequences=stop_seqs,
             budget=request.budget,
+            token_sink=detok.feed if detok is not None else None,
         )
 
         choices: List[Dict[str, Any]] = []
+        final_texts: List[Optional[str]] = []
         completion_tokens = 0
         for i in range(n):
             err = result.sample_errors[i] if result.sample_errors else None
@@ -488,6 +630,7 @@ class TpuBackend(Backend):
                         "sample_error": dict(err),
                     }
                 )
+                final_texts.append("")
                 continue
             length = int(result.lengths[i])
             ids = [int(t) for t in result.tokens[i][:length]]
@@ -556,6 +699,13 @@ class TpuBackend(Backend):
                     "sample_logprob": float(np.sum(result.logprobs[i][:length])),
                 }
             )
+            final_texts.append(text)
+
+        if detok is not None:
+            # Reconcile streamed deltas against the authoritative texts; this
+            # also covers generation paths with no token tap (speculative,
+            # SP-prefix) by emitting each sample's full text as one delta.
+            detok.flush_final(final_texts)
 
         digest = hashlib.md5(repr((request.messages, request.seed)).encode()).hexdigest()[:12]
         payload: Dict[str, Any] = {
@@ -599,6 +749,7 @@ class TpuBackend(Backend):
         logit_bias: Optional[Dict[int, float]] = None,
         stop_sequences: Optional[List[List[int]]] = None,
         budget=None,
+        token_sink=None,
     ):
         """Submit one generation through the coalescing scheduler: concurrent
         requests with the same sampling config decode as ONE batched XLA
@@ -636,6 +787,36 @@ class TpuBackend(Backend):
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
 
+        # Continuous in-flight batching: qualifying requests join the
+        # persistent slot loop the step after admission instead of waiting
+        # behind coalesced groups. Features that key the compiled program
+        # (constraints, top_logprobs, penalties, bias) stay on the coalescing
+        # path; stop SEQUENCES qualify because the host text scan above is
+        # authoritative (the loop just decodes to eos/max_new).
+        if (
+            self._continuous is not None
+            and constraint is None
+            and top_logprobs is None
+            and frequency_penalty == 0.0
+            and presence_penalty == 0.0
+            and logit_bias is None
+            and self._continuous.qualifies(len(prompt_ids), max(1, n), max_new)
+        ):
+            try:
+                return self._continuous.submit(
+                    list(prompt_ids),
+                    n=max(1, n),
+                    max_new=max_new,
+                    temperature=temperature,
+                    top_p=top_p,
+                    seed=seed,
+                    budget=budget,
+                    token_sink=token_sink,
+                ).result()
+            except ValueError:
+                # Templated prompt outgrew the loop's bounds — coalescing path.
+                pass
+
         def run(specs):
             dp_now = self.engine.data_parallel_size
             launch_rows = sum(
@@ -671,7 +852,7 @@ class TpuBackend(Backend):
         rows = ((max(1, n) + dp - 1) // dp) * dp
         return self.scheduler.call_batched(
             batch_key,
-            GenRequestSpec(list(prompt_ids), n, seed, budget),
+            GenRequestSpec(list(prompt_ids), n, seed, budget, token_sink),
             run,
             weight=rows,
             budget=budget,
@@ -816,6 +997,8 @@ class TpuBackend(Backend):
         # Loader's param summary (total bytes, dtype histogram, checksum) —
         # None when the engine runs on seeded params rather than a checkpoint.
         snap["params"] = self.param_summary
+        if self._continuous is not None:
+            snap["continuous"] = dict(self._continuous.stats)
         return snap
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -824,14 +1007,21 @@ class TpuBackend(Backend):
         True when everything completed within ``timeout`` (default:
         ``BackendConfig.drain_timeout``). Idempotent."""
         self._closed = True
-        return self.scheduler.drain(
-            timeout=self.backend_config.drain_timeout if timeout is None else timeout
-        )
+        t = self.backend_config.drain_timeout if timeout is None else timeout
+        ok = True
+        if self._continuous is not None:
+            # Quiesce the slot loop first: its admission gate follows the
+            # scheduler lifecycle, but in-flight slot rows finish on their own
+            # worker, not the scheduler's.
+            ok = self._continuous.drain(timeout=t)
+        return self.scheduler.drain(timeout=t) and ok
 
     def close(self) -> None:
         if self._closed and self.scheduler.state.value == "stopped":
             return
         self.drain()
+        if self._continuous is not None:
+            self._continuous.stop()
 
     # -- llm-consensus ----------------------------------------------------
     def llm_consensus(self, values: List[str]) -> str:

@@ -1,0 +1,604 @@
+"""Continuous (in-flight) batching: a persistent decode loop with slot admission.
+
+The coalescing scheduler (scheduler.py) batches requests that arrive inside an
+admission window and decodes the group to completion — late arrivals wait for
+the whole group to finish. This module is the Orca/vLLM-style alternative the
+serving path needs for streaming: a fixed-width decode batch of W slots that
+steps forever, where a request's n sample rows JOIN the batch the step after
+admission and LEAVE the moment they finish, freeing their slots for queued
+work. A late-arriving request therefore starts decoding mid-flight of earlier
+requests instead of behind them.
+
+Design:
+
+- Device state is a per-slot prompt-prefix KV ``[L, W, P, kvh, d]`` plus a
+  per-slot generation KV ``[L, W, G, kvh, d]``; ONE jitted step function
+  (``verify_step`` with Sq=1 — its per-row ``lengths`` write offsets are
+  exactly the mid-flight join primitive) advances all W slots regardless of
+  which request each row belongs to. Freed slots need no cache clearing: the
+  self-attention mask only exposes slots ``<= lengths``, and a new occupant's
+  first step overwrites offset 0 before attending it.
+- Sampling is a per-ROW array sampler (temperature[W] / top_p[W]) so requests
+  with different sampling configs share the batch — the coalescing scheduler's
+  batch_key compatibility restriction disappears. temperature 0 is greedy per
+  row; reported logprobs are the untempered model distribution's, matching
+  ``ops/sampling.sample_logits``. Row keys derive from
+  ``fold_in(fold_in(key(seed), step), sample_idx)`` — self-deterministic (same
+  seed → same tokens) regardless of batch composition, like the batch loop.
+- The host drives the loop: eos / per-request max_new retirement, budget
+  aborts (``engine.decode_abort``, same counter as the batch path), admission
+  (FIFO, a request needs all n slots at once), and per-step token delivery to
+  streaming sinks run between device steps. One step's host work is O(W).
+- Reliability: admission evaluates the ``engine.launch`` failpoint (an ``oom``
+  spec surfaces as a typed 503 — there is no split-and-requeue here, the width
+  is fixed), spent budgets shed before device work, and the backend's
+  DRAINING/STOPPED lifecycle gates admission via
+  ``EngineScheduler.admission_error``.
+
+Requests that need constraints, top_logprobs, penalties, or logit_bias stay on
+the coalescing path (TpuBackend routes; see ``_generate_batched``) — those
+features key the compiled program, which would fragment the shared loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from concurrent.futures import Future
+
+from ..models.llama import KVCache, init_cache, verify_step
+from ..reliability import failpoints as _failpoints
+from ..reliability.deadline import RequestBudget
+from ..types.wire import BackendUnavailableError, ServerDrainingError
+from ..utils.observability import FAILURE_EVENTS
+from .engine import GenerationResult, is_resource_exhausted
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _SlotRequest:
+    """Host-side record of one admitted request and its slot rows."""
+
+    future: Future
+    prompt_len: int
+    n: int
+    max_new: int
+    budget: Optional[RequestBudget]
+    token_sink: Optional[Callable[[int, np.ndarray], None]]
+    slots: List[int] = field(default_factory=list)
+    # Per-sample accumulators, index-aligned with ``slots``.
+    tokens: List[List[int]] = field(default_factory=list)
+    logprobs: List[List[float]] = field(default_factory=list)
+    done: List[bool] = field(default_factory=list)
+    finish: List[str] = field(default_factory=list)
+    steps_delivered: int = 0
+
+
+class ContinuousDecodeLoop:
+    """Persistent W-slot decode loop over one :class:`LocalEngine`.
+
+    ``width`` is the slot count (the HBM-aware cap is the caller's job — the
+    backend clamps it through its memory model); ``max_prompt`` / ``max_new``
+    bound the per-slot prefix and generation KV (requests beyond either bound
+    don't qualify and take the coalescing path).
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        width: int,
+        max_prompt: int,
+        max_new: int,
+        eos_ids: Optional[List[int]] = None,
+        admission_gate: Optional[Callable[[], Optional[BaseException]]] = None,
+    ) -> None:
+        self.engine = engine
+        self.width = int(width)
+        self.max_prompt = int(max_prompt)
+        self.max_new = int(max_new)
+        self.eos_ids = list(eos_ids or [engine.config.eos_token_id])
+        self._admission_gate = admission_gate
+        self._lock = threading.Condition()
+        self._queue: "deque[_SlotRequest]" = deque()
+        self._pending_prefill: Dict[int, Any] = {}
+        self._active: List[Optional[_SlotRequest]] = [None] * self.width
+        self._free: List[int] = list(range(self.width))
+        self._closing = False
+        self._stopped = False
+        # Host mirrors of per-slot device state.
+        self._cur = np.full((self.width,), engine.config.pad_token_id, np.int32)
+        self._gen_lens = np.zeros((self.width,), np.int32)
+        self._prompt_lens = np.ones((self.width,), np.int32)
+        self._seeds = np.zeros((self.width,), np.uint32)
+        self._sample_idx = np.zeros((self.width,), np.int32)
+        self._temps = np.ones((self.width,), np.float32)
+        self._top_ps = np.ones((self.width,), np.float32)
+        self._active_mask = np.zeros((self.width,), bool)
+        # Device KV state, built lazily on first admission (compile + HBM cost
+        # only when the feature is actually used).
+        self._prefix: Optional[KVCache] = None
+        self._gen: Optional[KVCache] = None
+        self._step_fn = None
+        self._write_prefix_fn = None
+        self._sample_rows_fn = None
+        # Stats (reported via backend health() and the bench workload).
+        self.stats: Dict[str, Any] = {
+            "steps": 0,
+            "row_steps": 0,
+            "admitted": 0,
+            "joined_in_flight": 0,
+            "completed": 0,
+            "aborted": 0,
+            "max_active_rows": 0,
+        }
+        self._thread: Optional[threading.Thread] = None
+
+    # -- public API --------------------------------------------------------
+
+    def qualifies(self, prompt_len: int, n: int, max_new: int) -> bool:
+        """Can this request shape run in the shared loop at all?"""
+        return (
+            n <= self.width
+            and prompt_len <= self.max_prompt
+            and max_new <= self.max_new
+        )
+
+    def submit(
+        self,
+        prompt_ids: List[int],
+        *,
+        n: int,
+        max_new: int,
+        temperature: float,
+        top_p: Optional[float],
+        seed: int,
+        budget: Optional[RequestBudget] = None,
+        token_sink: Optional[Callable[[int, np.ndarray], None]] = None,
+    ) -> Future:
+        """Queue one request for slot admission; returns a Future resolving to
+        a :class:`GenerationResult` (or raising the typed lifecycle error)."""
+        if self._admission_gate is not None:
+            err = self._admission_gate()
+            if err is not None:
+                raise err
+        with self._lock:
+            if self._closing or self._stopped:
+                raise ServerDrainingError(
+                    "continuous decode loop is draining; retry against "
+                    "another replica"
+                )
+        if budget is not None:
+            budget.check("continuous admission")
+        try:
+            _failpoints.fire("engine.launch")
+        except Exception as e:
+            if is_resource_exhausted(e):
+                # Fixed-width loop: there is nothing to split, so device OOM
+                # at admission is a typed unavailability, not a requeue.
+                raise BackendUnavailableError(
+                    f"continuous decode loop cannot admit request: {e}"
+                ) from e
+            raise
+        ids, prompt_len, _bkt = self.engine._prep_prompt(prompt_ids)
+        if not self.qualifies(prompt_len, n, max_new):
+            raise ValueError(
+                f"request (prompt_len={prompt_len}, n={n}, max_new={max_new}) "
+                f"exceeds loop bounds (W={self.width}, P={self.max_prompt}, "
+                f"G={self.max_new})"
+            )
+        req = _SlotRequest(
+            future=Future(),
+            prompt_len=prompt_len,
+            n=max(1, n),
+            max_new=max_new,
+            budget=budget,
+            token_sink=token_sink,
+        )
+        with self._lock:
+            self._pending_prefill[id(req)] = (ids, prompt_len, seed,
+                                              float(temperature),
+                                              1.0 if top_p is None else float(top_p))
+            self._queue.append(req)
+            self._ensure_worker()
+            self._lock.notify_all()
+        return req.future
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, finish queued + in-flight rows. True on quiesce."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            self._closing = True
+            self._lock.notify_all()
+            while self._queue or any(r is not None for r in self._active):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._lock.wait(timeout=min(0.1, remaining))
+        return True
+
+    def stop(self) -> None:
+        """Hard stop: fail queued work, kill the worker."""
+        with self._lock:
+            self._closing = True
+            self._stopped = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._lock.notify_all()
+        for req in pending:
+            if not req.future.done():
+                req.future.set_exception(
+                    BackendUnavailableError("continuous decode loop stopped")
+                )
+
+    # -- device programs ---------------------------------------------------
+
+    def _build_device_state(self) -> None:
+        config = self.engine.config
+        W, P, G = self.width, self.max_prompt, self.max_new
+        self._prefix = init_cache(config, W, P)
+        self._gen = init_cache(config, W, G)
+
+        pad_id = config.pad_token_id
+        # pad must stay unsampleable on live rows unless the tokenizer maps
+        # pad onto eos (then it IS the stop token) — same rule as the batch
+        # decode loop.
+        pad_sampleable = pad_id in self.eos_ids
+
+        def _row_keys(seeds, steps, sample_idx):
+            return jax.vmap(
+                lambda s, st, i: jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(s), st), i
+                )
+            )(seeds, steps, sample_idx)
+
+        def _sample_rows(logits, keys, temps, top_ps):
+            # Per-row temperature/top_p (the whole point of the shared loop);
+            # same sanitization + untempered-logprob contract as sample_logits.
+            V = logits.shape[-1]
+            finite = jnp.isfinite(logits)
+            row_ok = jnp.any(finite, axis=-1, keepdims=True)
+            logits = jnp.where(finite, logits, -jnp.inf)
+            logits = jnp.where(row_ok, logits, 0.0)
+            model_lps = jax.nn.log_softmax(logits, axis=-1)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            # Row-wise nucleus mask: keep the smallest prefix of the sorted
+            # distribution whose mass reaches top_p (boundary token kept).
+            sort_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sort_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = (cum - probs) < top_ps[:, None]
+            thresh = jnp.min(
+                jnp.where(keep, sort_desc, jnp.inf), axis=-1
+            )
+            masked = jnp.where(scaled >= thresh[:, None], scaled, -jnp.inf)
+            sampled = jax.vmap(jax.random.categorical)(keys, masked)
+            greedy = jnp.argmax(scaled, axis=-1)
+            tok = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+            lp = jnp.take_along_axis(model_lps, tok[:, None], axis=-1)[:, 0]
+            return tok, lp
+
+        def _mask_pad(logits):
+            if pad_sampleable:
+                return logits
+            return logits.at[:, pad_id].set(-jnp.inf)
+
+        def _step(params, prefix, gen, cur, gen_lens, prompt_lens, active,
+                  seeds, sample_idx, temps, top_ps):
+            # One token for all W slots: write cur's KV at each row's own
+            # offset (gen_lens), attend row-local prefix + generated KV.
+            logits, gen = verify_step(
+                config, params, cur[:, None], gen_lens, prompt_lens, gen, prefix
+            )
+            logits = _mask_pad(logits[:, 0, :])
+            keys = _row_keys(seeds, gen_lens + 1, sample_idx)
+            tok, lp = _sample_rows(logits, keys, temps, top_ps)
+            tok = jnp.where(active, tok, jnp.int32(pad_id))
+            lp = jnp.where(active, lp, 0.0)
+            return tok, lp, gen
+
+        # gen KV is donated: the loop is its only owner and it is re-passed
+        # every step, so the update happens in place on device.
+        self._step_fn = jax.jit(_step, donate_argnums=(2,))
+
+        def _write_prefix(prefix, new_k, new_v, rows):
+            # Admission: replicate one request's prefill KV into its n slots.
+            k = prefix.k.at[:, rows].set(new_k)
+            v = prefix.v.at[:, rows].set(new_v)
+            return KVCache(k=k, v=v)
+
+        self._write_prefix_fn = jax.jit(_write_prefix, donate_argnums=(0,))
+
+        def _admit_sample(first_logits, seeds, sample_idx, temps, top_ps):
+            # First token, sampled at admission from the prefill logits at
+            # step 0 — padded to W rows so every admission shares one program.
+            keys = _row_keys(seeds, jnp.zeros_like(sample_idx), sample_idx)
+            return _sample_rows(_mask_pad(first_logits), keys, temps, top_ps)
+
+        self._admit_sample_fn = jax.jit(_admit_sample)
+
+    # -- worker ------------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name="kllms-continuous", daemon=True
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    if self._stopped:
+                        return
+                    self._admit_locked()
+                    has_work = self._active_mask.any()
+                    if not has_work:
+                        if self._closing and not self._queue:
+                            self._lock.notify_all()
+                            return
+                        # Wake for new arrivals; re-check queued budgets at a
+                        # coarse interval so expired deadlines shed.
+                        self._lock.wait(timeout=0.05)
+                        self._shed_expired_locked()
+                        continue
+                try:
+                    self._step_once()
+                except Exception:
+                    logger.exception("continuous decode step failed")
+                    self._fail_all(BackendUnavailableError(
+                        "continuous decode loop failed; see server logs"
+                    ))
+                    return
+        except Exception:  # pragma: no cover - defensive
+            logger.exception("continuous decode worker crashed")
+
+    def _shed_expired_locked(self) -> None:
+        kept: "deque[_SlotRequest]" = deque()
+        for req in self._queue:
+            if req.budget is not None and req.budget.should_abort():
+                self._pending_prefill.pop(id(req), None)
+                FAILURE_EVENTS.record("scheduler.shed")
+                req.future.set_exception(req.budget.error("continuous queue"))
+            else:
+                kept.append(req)
+        self._queue = kept
+
+    def _admit_locked(self) -> None:
+        """FIFO head-of-line admission: the head request joins when all n of
+        its slots are free (no skipping — later small requests must not starve
+        a large head). Called with the lock held; does device writes for the
+        admitted request's prefill."""
+        while self._queue and len(self._free) >= self._queue[0].n:
+            req = self._queue.popleft()
+            ids, prompt_len, seed, temperature, top_p = self._pending_prefill.pop(
+                id(req)
+            )
+            if req.budget is not None and req.budget.should_abort():
+                FAILURE_EVENTS.record("scheduler.shed")
+                req.future.set_exception(req.budget.error("continuous queue"))
+                continue
+            if self._prefix is None:
+                self._build_device_state()
+            in_flight = self._active_mask.any()
+            rows = [self._free.pop(0) for _ in range(req.n)]
+            req.slots = rows
+            try:
+                self._admit_device(req, rows, ids, prompt_len, seed,
+                                   temperature, top_p)
+            except Exception as e:
+                for r in rows:
+                    self._free.append(r)
+                req.future.set_exception(e)
+                continue
+            self.stats["admitted"] += 1
+            if in_flight:
+                self.stats["joined_in_flight"] += 1
+
+    def _admit_device(self, req, rows, ids, prompt_len, seed, temperature,
+                      top_p) -> None:
+        engine = self.engine
+        _ids, _plen, bucket = engine._prep_prompt(ids)
+        first_logits, prefix = engine._prefill_routed(_ids, _plen, bucket)
+        pk, pv = prefix.k, prefix.v
+        if bucket < self.max_prompt:
+            pad = [(0, 0)] * 5
+            pad[2] = (0, self.max_prompt - bucket)
+            pk, pv = jnp.pad(pk, pad), jnp.pad(pv, pad)
+        rows_arr = jnp.asarray(np.asarray(rows, np.int32))
+        n = len(rows)
+        rep_k = jnp.broadcast_to(pk[:, 0:1], (pk.shape[0], n) + pk.shape[2:])
+        rep_v = jnp.broadcast_to(pv[:, 0:1], (pv.shape[0], n) + pv.shape[2:])
+        self._prefix = self._write_prefix_fn(self._prefix, rep_k, rep_v, rows_arr)
+
+        # First-token sampling at admission (step 0), padded to W rows.
+        W = self.width
+        V = first_logits.shape[-1]
+        fl = jnp.broadcast_to(first_logits[0:1], (W, V))
+        seeds = np.zeros((W,), np.uint32)
+        seeds[:n] = np.uint32(seed & 0xFFFFFFFF)
+        sidx = np.zeros((W,), np.int32)
+        sidx[:n] = np.arange(n, dtype=np.int32)
+        temps = np.full((W,), 1.0, np.float32)
+        temps[:n] = temperature
+        tps = np.full((W,), 1.0, np.float32)
+        tps[:n] = top_p
+        tok0, lp0 = self._admit_sample_fn(
+            fl, jnp.asarray(seeds), jnp.asarray(sidx), jnp.asarray(temps),
+            jnp.asarray(tps),
+        )
+        tok0 = np.asarray(jax.device_get(tok0))[:n]
+        lp0 = np.asarray(jax.device_get(lp0))[:n]
+
+        for j, slot in enumerate(rows):
+            self._active[slot] = req
+            self._active_mask[slot] = True
+            self._cur[slot] = tok0[j]
+            self._gen_lens[slot] = 0  # KV written so far; tok0's comes next step
+            self._prompt_lens[slot] = prompt_len
+            self._seeds[slot] = np.uint32(seed & 0xFFFFFFFF)
+            self._sample_idx[slot] = j
+            self._temps[slot] = temperature
+            self._top_ps[slot] = top_p
+            req.tokens.append([int(tok0[j])])
+            req.logprobs.append([float(lp0[j])])
+            done0 = int(tok0[j]) in self.eos_ids
+            req.done.append(done0 or req.max_new <= 1)
+            req.finish.append("stop" if done0 else "length")
+        self._deliver_sink(req)
+        self._retire_finished_rows(req)
+        self._resolve_if_done(req)
+
+    def _step_once(self) -> None:
+        with self._lock:
+            active_reqs = {
+                id(r): r for r in self._active if r is not None
+            }
+            cur = jnp.asarray(self._cur)
+            gen_lens = jnp.asarray(self._gen_lens)
+            prompt_lens = jnp.asarray(self._prompt_lens)
+            active = jnp.asarray(self._active_mask)
+            seeds = jnp.asarray(self._seeds)
+            sidx = jnp.asarray(self._sample_idx)
+            temps = jnp.asarray(self._temps)
+            tps = jnp.asarray(self._top_ps)
+        tok, lp, self._gen = self._step_fn(
+            self.engine.params, self._prefix, self._gen, cur, gen_lens,
+            prompt_lens, active, seeds, sidx, temps, tps,
+        )
+        tok_np, lp_np = map(np.asarray, jax.device_get((tok, lp)))
+        with self._lock:
+            self.stats["steps"] += 1
+            self.stats["row_steps"] += int(self._active_mask.sum())
+            self.stats["max_active_rows"] = max(
+                self.stats["max_active_rows"], int(self._active_mask.sum())
+            )
+            touched = set()
+            for slot in range(self.width):
+                req = self._active[slot]
+                if req is None:
+                    continue
+                j = req.slots.index(slot)
+                if req.done[j]:
+                    continue
+                self._gen_lens[slot] += 1  # cur's KV is now written
+                t = int(tok_np[slot])
+                self._cur[slot] = t
+                req.tokens[j].append(t)
+                req.logprobs[j].append(float(lp_np[slot]))
+                if t in self.eos_ids:
+                    req.done[j] = True
+                    req.finish[j] = "stop"
+                elif len(req.tokens[j]) >= req.max_new:
+                    req.done[j] = True
+                    req.finish[j] = "length"
+                touched.add(id(req))
+            for rid in touched:
+                req = next(
+                    r for r in self._active if r is not None and id(r) == rid
+                )
+                if req.budget is not None and req.budget.should_abort():
+                    self._abort_request(req)
+                    continue
+                self._deliver_sink(req)
+                self._retire_finished_rows(req)
+                self._resolve_if_done(req)
+            self._lock.notify_all()
+
+    # -- retirement --------------------------------------------------------
+
+    def _deliver_sink(self, req: _SlotRequest) -> None:
+        if req.token_sink is None:
+            return
+        step = req.steps_delivered
+        # Every live sample has produced its step-th token by construction
+        # (rows of one request march in lockstep until they finish; finished
+        # rows report pad thereafter, which the sink's detokenizer skips).
+        pad = self.engine.config.pad_token_id
+        row = np.array(
+            [
+                s[step] if step < len(s) else pad
+                for s in req.tokens
+            ],
+            np.int32,
+        )
+        try:
+            req.token_sink(step, row)
+        except Exception:
+            logger.exception("continuous token sink failed; dropping tap")
+            req.token_sink = None
+        req.steps_delivered += 1
+
+    def _retire_finished_rows(self, req: _SlotRequest) -> None:
+        for j, slot in enumerate(list(req.slots)):
+            if req.done[j] and self._active[slot] is req and self._active_mask[slot]:
+                self._active_mask[slot] = False
+                self._cur[slot] = self.engine.config.pad_token_id
+                self._active[slot] = None
+                self._free.append(slot)
+
+    def _resolve_if_done(self, req: _SlotRequest) -> None:
+        if not all(req.done):
+            return
+        # Flush any trailing sink steps (rows finish at different lengths;
+        # the longest row's final tokens may not have been delivered yet).
+        if req.token_sink is not None:
+            longest = max(len(s) for s in req.tokens)
+            while req.steps_delivered < longest:
+                self._deliver_sink(req)
+        pad = self.engine.config.pad_token_id
+        toks = np.full((req.n, req.max_new), pad, np.int32)
+        lps = np.zeros((req.n, req.max_new), np.float32)
+        lengths = np.zeros((req.n,), np.int32)
+        for j in range(req.n):
+            L = len(req.tokens[j])
+            # eos is recorded in the buffer like the batch loop (lengths count
+            # non-pad tokens; the backend strips stop ids from the text).
+            toks[j, :L] = req.tokens[j]
+            lps[j, :L] = req.logprobs[j]
+            lengths[j] = L
+        result = GenerationResult(
+            tokens=toks,
+            logprobs=lps,
+            lengths=lengths,
+            finish_reasons=list(req.finish),
+            prompt_len=req.prompt_len,
+            spec_stats={},
+        )
+        self.stats["completed"] += 1
+        if not req.future.done():
+            req.future.set_result(result)
+
+    def _abort_request(self, req: _SlotRequest) -> None:
+        FAILURE_EVENTS.record("engine.decode_abort")
+        for j in range(req.n):
+            req.done[j] = True
+        self._retire_finished_rows(req)
+        self.stats["aborted"] += 1
+        if not req.future.done():
+            req.future.set_exception(req.budget.error("engine decode"))
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            reqs = {id(r): r for r in self._active if r is not None}
+            for req in reqs.values():
+                for j in range(req.n):
+                    req.done[j] = True
+                self._retire_finished_rows(req)
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            for req in self._queue:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            self._queue.clear()
+            self._lock.notify_all()

@@ -22,7 +22,7 @@ import logging
 import os
 import random as _pyrandom
 from functools import partial
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -164,6 +164,12 @@ class GenRequestSpec(NamedTuple):
     # batch_key — requests with different deadlines still coalesce; each row
     # group aborts independently via the decode loop's cancellation poll.
     budget: Optional[RequestBudget] = None
+    # Streaming tap: called from the host as ``sink(step, token_ids[n_per])``
+    # for each decode step of THIS request's rows (best-effort — delivery is
+    # via an unordered io_callback; the engine reorders and dedups, and the
+    # caller must reconcile against the final GenerationResult). Like budget,
+    # not part of the batch_key: streaming and non-streaming requests coalesce.
+    token_sink: Optional[Callable[[int, np.ndarray], None]] = None
 
 
 def _kill_sample_errors(n: int, fp: "_failpoints.FailSpec") -> List[Optional[Dict[str, Any]]]:
@@ -884,6 +890,68 @@ class LocalEngine:
 
         return poll
 
+    # -- streaming tap ----------------------------------------------------
+    def _reset_tap_state(self) -> None:
+        """Per-launch reorder state for the streaming token tap. The scheduler
+        serializes device launches, so one tap stream is live at a time."""
+        self._tap_state = {"next": 0, "pending": {}, "seen": set()}
+
+    def _deliver_tap_step(self, step: int, toks: np.ndarray) -> None:
+        """Deliver one step's tokens to the active sinks IN ORDER. The tap's
+        io_callback is unordered (XLA may run it out of step order, twice, or
+        drop it if the result were unused — the marker data-dependency
+        prevents the last), so arrivals go through a step-keyed reorder
+        buffer with a seen-set: sinks observe step 0,1,2,... exactly once.
+        Steps that never arrive stall the buffer harmlessly; the backend's
+        final flush reconciles against the completed GenerationResult."""
+        state = getattr(self, "_tap_state", None)
+        sinks = getattr(self, "_active_token_sinks", None)
+        if state is None or not sinks:
+            return
+        if step in state["seen"]:
+            return
+        state["seen"].add(step)
+        state["pending"][step] = toks
+        while state["next"] in state["pending"]:
+            rows = state["pending"].pop(state["next"])  # [R_pad, n_per]
+            for r, sink in enumerate(sinks):
+                if sink is None:
+                    continue
+                try:
+                    sink(state["next"], rows[r])
+                except Exception:  # a broken sink must not poison decode
+                    logger.exception("token sink failed; dropping stream tap")
+                    sinks[r] = None
+            state["next"] += 1
+
+    def _token_tap(self, num_requests: int, n_per: int):
+        """Host-side per-step token delivery as a jit-safe callable, mirroring
+        ``_abort_poller``: the callback closes over ``self`` so compiled loops
+        cached across requests always feed the CURRENT request's sinks; the
+        (R, n_per) grouping is frozen into the closure alongside the compiled
+        shape it describes. The returned marker is always False; callers must
+        fold it into loop state (``done = done | marker``) so XLA cannot elide
+        the unordered callback."""
+
+        def _host_deliver(step, toks):
+            try:
+                rows = np.asarray(toks).reshape(num_requests, n_per)
+                self._deliver_tap_step(int(step), rows)
+            except Exception:  # never raise through the runtime
+                logger.exception("token tap delivery failed")
+            return np.bool_(False)
+
+        def tap(step, toks):
+            return io_callback(
+                _host_deliver,
+                jax.ShapeDtypeStruct((), jnp.bool_),
+                step,
+                toks,
+                ordered=False,
+            )
+
+        return tap
+
     def _apply_decode_faults(
         self, result: GenerationResult, budget: Optional[RequestBudget]
     ) -> GenerationResult:
@@ -1008,6 +1076,7 @@ class LocalEngine:
         use_stops: bool = False,
         sp_prefix: bool = False,
         use_cancel: bool = False,
+        use_stream: bool = False,
     ):
         """Jitted decode loop for R requests × n_per samples each (R=1 is the
         single-request case; R>1 is the cross-request coalesced batch).
@@ -1031,7 +1100,7 @@ class LocalEngine:
         cache_key = (
             num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
             top_logprobs, frequency_penalty, presence_penalty, use_logit_bias,
-            use_stops, sp_prefix, use_cancel,
+            use_stops, sp_prefix, use_cancel, use_stream,
         )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
@@ -1046,6 +1115,7 @@ class LocalEngine:
             jt, initial_state, mask_logits, advance = cops
 
         abort_poll = self._abort_poller(R) if use_cancel else None
+        token_tap = self._token_tap(R, n_per) if use_stream else None
 
         def _row_keys(req_keys, step):
             # fold_in(fold_in(req_key, step), row_within_request): with R=1
@@ -1121,6 +1191,11 @@ class LocalEngine:
             if jstate is not None:
                 jstate = advance(jt, tok0, *jstate)
             done0 = jnp.logical_or(jnp.isin(tok0, eos_ids), bad0)
+            if use_stream:
+                # Streaming tap, step 0: the marker is constant-False but MUST
+                # be folded into loop state or XLA elides the unordered
+                # callback (it has no other consumer).
+                done0 = jnp.logical_or(done0, token_tap(jnp.int32(0), tok0))
 
             def _stop_match(recent):
                 return stop_window_match(recent, stops)
@@ -1221,6 +1296,8 @@ class LocalEngine:
                     # (rows are request-major, hence the n_per repeat).
                     aborted = abort_poll(step)
                     done = jnp.logical_or(done, jnp.repeat(aborted, n_per))
+                if use_stream:
+                    done = jnp.logical_or(done, token_tap(step + 1, nxt))
                 return (step + 1, nxt, done, cache, toks, lps, tt, tl, counts, jst, recent, pois)
 
             state = (
@@ -1908,6 +1985,7 @@ class LocalEngine:
         logit_bias: Optional[Dict[int, float]] = None,
         stop_sequences: Optional[Sequence[Sequence[int]]] = None,
         budget: Optional[RequestBudget] = None,
+        token_sink: Optional[Callable[[int, np.ndarray], None]] = None,
     ) -> GenerationResult:
         config = self.config
         if budget is not None:
@@ -1977,8 +2055,11 @@ class LocalEngine:
             use_stops=use_stops,
             sp_prefix=sp_resident,
             use_cancel=budget is not None,
+            use_stream=token_sink is not None,
         )
         self._active_budgets = [budget]
+        self._active_token_sinks = [token_sink] if token_sink is not None else None
+        self._reset_tap_state()
         try:
             toks, lps, done, tt, tl, pois = loop(
                 self.params,
@@ -2001,6 +2082,7 @@ class LocalEngine:
             )
         finally:
             self._active_budgets = None
+            self._active_token_sinks = None
         toks_np = np.asarray(toks_np)[:n]
         lps_np = np.asarray(lps_np)[:n]
         done_np = np.asarray(done_np)[:n]
@@ -2139,6 +2221,7 @@ class LocalEngine:
                         logit_bias=logit_bias,
                         stop_sequences=stop_sequences,
                         budget=it.budget,
+                        token_sink=it.token_sink,
                     )
                 ]
             except Exception as e:
@@ -2244,12 +2327,14 @@ class LocalEngine:
             )
             return self._finalize_many(items, results)
 
+        use_stream = any(it.token_sink is not None for it in items)
         loop = self._get_decode_loop(
             r_pad, n_per, max_new_tokens, temperature, top_p, top_k, constraint,
             top_logprobs, frequency_penalty, presence_penalty,
             use_logit_bias=logit_bias is not None,
             use_stops=use_stops,
             use_cancel=use_cancel,
+            use_stream=use_stream,
         )
         live = [
             i
@@ -2257,6 +2342,10 @@ class LocalEngine:
             for i in range(j * n_per, j * n_per + max(1, it.n))
         ]
         self._active_budgets = [it.budget for it in items]
+        self._active_token_sinks = (
+            [it.token_sink for it in items] if use_stream else None
+        )
+        self._reset_tap_state()
         try:
             toks, lps, done, tt, tl, pois = loop(
                 self.params, prefix, prompt_lens, first_logits, req_keys, eos_arr,
@@ -2268,6 +2357,7 @@ class LocalEngine:
             )
         finally:
             self._active_budgets = None
+            self._active_token_sinks = None
         results = self._slice_many_results(
             items, preps, n_per, toks_np, lps_np, done_np, tt_np, tl_np,
             top_logprobs, spec_stats_fn=lambda lo, n_j: {}, pois_np=pois_np,

@@ -536,6 +536,22 @@ class EngineScheduler:
             self._queue_weight -= v.weight
         return victims
 
+    def admission_error(self) -> Optional[BaseException]:
+        """Lifecycle-state admission gate as a typed error, or None while the
+        server accepts work. Shared by ``_admit`` and request paths that
+        bypass the coalescing queue (the continuous decode loop), so
+        DRAINING/STOPPED produce identical wire errors everywhere."""
+        with self._cv:
+            if self._state is ServerState.STOPPED:
+                return BackendUnavailableError(
+                    "scheduler is stopped; no further work is accepted"
+                )
+            if self._state is ServerState.DRAINING:
+                return ServerDrainingError(
+                    "server is draining; retry against another replica"
+                )
+        return None
+
     def _admit(self, item: _Item) -> bool:
         """Admission control, atomic with the queue append: lifecycle state
         gate (DRAINING/STOPPED → typed 503), spent-budget rejection, and the

@@ -32,6 +32,12 @@ Injection sites wired in this package:
 - ``replica.probe``      — evaluated (keyed by replica id) at the top of a
                            replica health probe; ``fail`` keeps a pulled
                            member out of rotation until the spec exhausts
+- ``serving.request``    — evaluated by the HTTP front door at request entry
+                           (``serving/app.py``); the ``disconnect`` action
+                           makes the server treat the client as having dropped
+                           mid-stream after the first delta chunk, exercising
+                           the disconnect → budget-cancel → decode-abort path
+                           without a real socket teardown
 
 Actions (``FailSpec.action``):
 
@@ -56,6 +62,9 @@ Actions (``FailSpec.action``):
                        keyed site pass through without consuming ``times``
 - ``"fail"``         — raise RuntimeError for the member named by ``member``
                        (generic probe/dispatch failure, keyed like ``down``)
+- ``"disconnect"``   — no-op at the site itself; the serving layer reads the
+                       spec and simulates the client dropping the connection
+                       mid-stream (cancel budget, abort the SSE response)
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -67,8 +76,9 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="engine.launch=hang:1:30,engine.logits=nan:2:7"
     KLLMS_FAILPOINTS="loader.params=corrupt:1"
     KLLMS_FAILPOINTS="replica.dispatch=down:r1:2,replica.probe=fail:r1:1"
-where the first numeric arg is ``times`` for raise/sleep/oom/corrupt specs,
-``times[:delay]`` for hang, ``kill[:seed]`` for kill_samples/nan, and
+    KLLMS_FAILPOINTS="serving.request=disconnect:1"
+where the first numeric arg is ``times`` for raise/sleep/oom/corrupt/disconnect
+specs, ``times[:delay]`` for hang, ``kill[:seed]`` for kill_samples/nan, and
 ``member[:times]`` for down/fail (replica sites are keyed by replica id).
 """
 
@@ -95,6 +105,7 @@ SITES = (
     "consensus.consolidate",
     "replica.dispatch",
     "replica.probe",
+    "serving.request",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the
@@ -116,7 +127,7 @@ def _injected_oom() -> BaseException:
 @dataclass
 class FailSpec:
     # "raise" | "oom" | "sleep" | "hang" | "kill_samples" | "nan" | "corrupt"
-    # | "down" | "fail"
+    # | "down" | "fail" | "disconnect"
     action: str = "raise"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
@@ -139,6 +150,7 @@ class FailSpec:
             "corrupt",
             "down",
             "fail",
+            "disconnect",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -175,7 +187,7 @@ def fire(site: str) -> Optional[FailSpec]:
     if spec.action in ("sleep", "hang"):
         time.sleep(spec.delay)
         return None
-    return spec  # kill_samples/nan/corrupt: the site's owner interprets it
+    return spec  # kill_samples/nan/corrupt/disconnect: the site's owner interprets it
 
 
 def fire_keyed(site: str, key: str) -> Optional[FailSpec]:
@@ -265,7 +277,7 @@ def configure_from_env(env: Optional[str] = None) -> None:
             times = int(args[0]) if args else 1
             delay = float(args[1]) if len(args) > 1 else HANG_DELAY
             specs[site] = FailSpec(action="hang", times=times, delay=delay)
-        elif action in ("oom", "corrupt"):
+        elif action in ("oom", "corrupt", "disconnect"):
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action=action, times=times)
         elif action in ("down", "fail"):

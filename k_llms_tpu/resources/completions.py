@@ -11,7 +11,11 @@ embedding provider wired into a :class:`SimilarityScorer`.
 from __future__ import annotations
 
 import asyncio
-from typing import TYPE_CHECKING, Any, List, Optional, Type, Union
+import hashlib
+import queue
+import threading
+import time
+from typing import TYPE_CHECKING, Any, Dict, Iterator, List, Optional, Type, Union
 
 from pydantic import BaseModel
 
@@ -24,6 +28,7 @@ from ..consensus.settings import ConsensusSettings
 from ..consensus.similarity import SimilarityScorer
 from ..reliability.deadline import RequestBudget
 from ..types import KLLMsChatCompletion, KLLMsParsedChatCompletion
+from ..types.wire import InvalidRequestError
 from ..utils.observability import Trace
 
 import logging
@@ -79,7 +84,10 @@ def _build_request(
     timeout: Optional[float] = None,
 ) -> ChatRequest:
     kwargs = dict(kwargs)
-    kwargs.pop("stream", None)  # streaming unsupported, like the reference (:36)
+    # ``stream`` is an explicit parameter of create()/parse() now; anything
+    # still arriving here came through **kwargs on an internal path and must
+    # not leak into ChatRequest.extra.
+    kwargs.pop("stream", None)
     # Lifecycle budget: ``timeout=`` (seconds, the OpenAI per-call wire
     # contract) builds one; advanced callers pass ``budget=`` directly to hold
     # the cancel handle. Deadline.from_timeout 400s a negative timeout here,
@@ -144,6 +152,197 @@ def _build_request(
     )
 
 
+class ChatCompletionStream:
+    """Iterator of OpenAI-wire streaming events for one n-way request.
+
+    Yields plain dicts ready for ``json.dumps``: ``chat.completion.chunk``
+    deltas for the n live samples (wire ``choices`` index ``i+1`` — index 0 is
+    reserved for the consensus), a finish chunk per sample once sampling
+    completes, then ONE final ``chat.completion`` event carrying the fully
+    consolidated response (consensus ``choices[0]`` + ``likelihoods``).
+
+    The backend dispatch + consolidation run on a dedicated worker thread so
+    deltas reach the consumer as they land; the consumer-side iterator is the
+    only queue reader. ``close()`` cancels the request's budget, which aborts
+    decode at token granularity through the engine's abort poller — this is
+    what a client disconnect maps to. Every stream owns a budget (one is
+    created when the caller passed none) precisely so that handle exists.
+    """
+
+    def __init__(
+        self,
+        backend: Any,
+        request: ChatRequest,
+        settings: ConsensusSettings,
+        scorer: SimilarityScorer,
+        llm_consensus_fn: Any,
+    ) -> None:
+        if request.budget is None:
+            request.budget = RequestBudget()
+        self._backend = backend
+        self._request = request
+        self._settings = settings
+        self._scorer = scorer
+        self._llm_consensus_fn = llm_consensus_fn
+        self._id = "chatcmpl-stream-" + hashlib.md5(
+            f"{request.messages}|{request.seed}".encode()
+        ).hexdigest()[:12]
+        self._created = int(time.time())
+        self._events: "queue.Queue[tuple]" = queue.Queue()
+        self._pending: List[Dict[str, Any]] = []
+        self._roles_sent: set = set()
+        self._response: Optional[KLLMsChatCompletion] = None
+        self._completion: Optional[Any] = None
+        self._closed = False
+        self._exhausted = False
+        self._thread = threading.Thread(
+            target=self._run, name="kllms-stream", daemon=True
+        )
+        self._thread.start()
+
+    # -- worker side ---------------------------------------------------------
+
+    def _emit(self, sample_idx: int, delta: str) -> None:
+        self._events.put(("delta", sample_idx, delta))
+
+    def _run(self) -> None:
+        try:
+            completion = self._backend.dispatch_chat_completion_stream(
+                self._request, self._emit
+            )
+            # Finish chunks can go out while consolidation is still running.
+            self._events.put(("sampled", completion))
+            result = consolidate_chat_completions(
+                completion,
+                self._scorer,
+                consensus_settings=self._settings,
+                llm_consensus_fn=self._llm_consensus_fn,
+                budget=self._request.budget,
+            )
+            self._events.put(("final", result))
+        except BaseException as e:  # surfaced on the consumer side
+            self._events.put(("error", e))
+        else:
+            self._events.put(("done", None))
+
+    # -- consumer side -------------------------------------------------------
+
+    def _chunk(
+        self,
+        wire_index: int,
+        delta: Dict[str, Any],
+        finish_reason: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        return {
+            "id": self._id,
+            "object": "chat.completion.chunk",
+            "created": self._created,
+            "model": self._request.model,
+            "choices": [
+                {
+                    "index": wire_index,
+                    "delta": delta,
+                    "finish_reason": finish_reason,
+                    "logprobs": None,
+                }
+            ],
+        }
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        return self
+
+    def __next__(self) -> Dict[str, Any]:
+        while True:
+            if self._pending:
+                return self._pending.pop(0)
+            if self._exhausted:
+                raise StopIteration
+            kind, *payload = self._events.get()
+            if kind == "delta":
+                sample_idx, text = payload
+                delta: Dict[str, Any] = {"content": text}
+                if sample_idx not in self._roles_sent:
+                    self._roles_sent.add(sample_idx)
+                    delta = {"role": "assistant", "content": text}
+                return self._chunk(sample_idx + 1, delta)
+            if kind == "sampled":
+                (completion,) = payload
+                self._completion = completion
+                for i, choice in enumerate(completion.choices):
+                    self._pending.append(
+                        self._chunk(i + 1, {}, finish_reason=choice.finish_reason)
+                    )
+                continue
+            if kind == "final":
+                (result,) = payload
+                self._response = result
+                return result.model_dump(mode="json")
+            if kind == "error":
+                self._exhausted = True
+                raise payload[0]
+            # "done"
+            self._exhausted = True
+            raise StopIteration
+
+    @property
+    def response(self) -> Optional[KLLMsChatCompletion]:
+        """The consolidated final response; None until the final event."""
+        return self._response
+
+    def close(self) -> None:
+        """Abandon the stream: cancel the budget (aborts decode through the
+        engine's poller) and unblock/join the worker. Idempotent; safe from a
+        disconnect handler racing normal completion."""
+        if self._closed:
+            return
+        self._closed = True
+        self._exhausted = True
+        if self._request.budget is not None:
+            self._request.budget.cancel()
+        # Drain whatever the worker still enqueues so its puts never block
+        # (unbounded queue — this is belt-and-braces) and join it briefly;
+        # daemon=True means a wedged backend cannot hang interpreter exit.
+        self._thread.join(timeout=30.0)
+
+    def __enter__(self) -> "ChatCompletionStream":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class AsyncChatCompletionStream:
+    """Async-iterator facade over :class:`ChatCompletionStream` — each event is
+    pulled with ``asyncio.to_thread`` so the loop never blocks on the queue."""
+
+    _SENTINEL = object()
+
+    def __init__(self, stream: ChatCompletionStream) -> None:
+        self._stream = stream
+
+    def __aiter__(self) -> "AsyncChatCompletionStream":
+        return self
+
+    async def __anext__(self) -> Dict[str, Any]:
+        item = await asyncio.to_thread(next, self._stream, self._SENTINEL)
+        if item is self._SENTINEL:
+            raise StopAsyncIteration
+        return item
+
+    @property
+    def response(self) -> Optional[KLLMsChatCompletion]:
+        return self._stream.response
+
+    async def close(self) -> None:
+        await asyncio.to_thread(self._stream.close)
+
+    async def __aenter__(self) -> "AsyncChatCompletionStream":
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+
 class Completions:
     def __init__(self, wrapper: "KLLMs"):
         self._wrapper = wrapper
@@ -173,8 +372,9 @@ class Completions:
         response_format: Optional[Any] = None,
         consensus_settings: Optional[ConsensusSettings] = None,
         timeout: Optional[float] = None,
+        stream: bool = False,
         **kwargs: Any,
-    ) -> KLLMsChatCompletion:
+    ) -> Union[KLLMsChatCompletion, ChatCompletionStream]:
         settings = consensus_settings or ConsensusSettings()
         if timeout is None:
             timeout = getattr(self._wrapper, "default_timeout", None)
@@ -183,6 +383,21 @@ class Completions:
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
             timeout=timeout,
         )
+        if stream:
+            backend = self._wrapper.backend
+            if not getattr(backend, "supports_streaming", False):
+                raise InvalidRequestError(
+                    f"stream=True is not supported by {type(backend).__name__}; "
+                    "use stream=False or a streaming-capable backend",
+                    param="stream",
+                )
+            return ChatCompletionStream(
+                backend,
+                request,
+                settings,
+                self._scorer(settings),
+                backend.llm_consensus,
+            )
         trace = Trace()
         with trace.phase("sample"):
             completion = self._wrapper.backend.dispatch_chat_completion(request)
@@ -212,8 +427,18 @@ class Completions:
         seed: Optional[int] = None,
         consensus_settings: Optional[ConsensusSettings] = None,
         timeout: Optional[float] = None,
+        stream: bool = False,
         **kwargs: Any,
     ) -> KLLMsParsedChatCompletion:
+        if stream:
+            # Structured parse needs the complete body to validate against the
+            # schema; partial JSON deltas would parse to garbage. Typed 400,
+            # mirroring OpenAI's "stream is not supported with parse".
+            raise InvalidRequestError(
+                "stream=True is not supported with parse(); "
+                "use create(stream=True) or parse(stream=False)",
+                param="stream",
+            )
         settings = consensus_settings or ConsensusSettings()
         if timeout is None:
             timeout = getattr(self._wrapper, "default_timeout", None)
@@ -245,8 +470,13 @@ class AsyncCompletions:
         self._wrapper = wrapper
         self._sync = Completions(wrapper)  # type: ignore[arg-type]
 
-    async def create(self, **kwargs: Any) -> KLLMsChatCompletion:
-        return await asyncio.to_thread(lambda: self._sync.create(**kwargs))
+    async def create(
+        self, **kwargs: Any
+    ) -> Union[KLLMsChatCompletion, AsyncChatCompletionStream]:
+        result = await asyncio.to_thread(lambda: self._sync.create(**kwargs))
+        if isinstance(result, ChatCompletionStream):
+            return AsyncChatCompletionStream(result)
+        return result
 
     async def parse(self, **kwargs: Any) -> KLLMsParsedChatCompletion:
         return await asyncio.to_thread(lambda: self._sync.parse(**kwargs))

@@ -1,0 +1,406 @@
+"""Framework-free ASGI app: the OpenAI-wire HTTP front door.
+
+The container bakes in no ASGI framework, so this is the protocol itself — a
+plain ``async def __call__(scope, receive, send)`` — which also makes it
+directly mountable under ``httpx.ASGITransport`` for hermetic in-process wire
+tests (no sockets, byte-for-byte assertions against the client library).
+
+Routes:
+
+    POST /v1/chat/completions   stream=false → one JSON ChatCompletion whose
+                                bytes match KLLMs.create()'s model_dump;
+                                stream=true → SSE ``chat.completion.chunk``
+                                deltas per sample (wire choice index 1..n)
+                                then ONE final consensus ``chat.completion``
+                                event (consolidated choices[0] + likelihoods),
+                                then ``data: [DONE]``.
+    GET  /healthz               scheduler lifecycle snapshot; 200 while the
+                                backend admits work, 503 once DRAINING/STOPPED.
+    GET  /metrics               text dump of every observability counter.
+
+Typed wire errors map to HTTP: each KLLMsError carries ``status_code`` and an
+OpenAI-shaped ``as_wire()`` body, so 429/503/408/400 come out of the SAME
+exception types the in-process client raises; RateLimitError's scheduler
+estimate becomes a ``Retry-After`` header.
+
+A client disconnect mid-stream cancels the decode: the ASGI ``http.disconnect``
+message closes the ChatCompletionStream, whose budget-cancel propagates through
+the engine's abort poller (``engine.decode_abort``). The ``serving.request``
+failpoint's ``disconnect`` action simulates exactly that drop after the first
+delta, deterministic enough for the soak test.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..reliability import failpoints as _failpoints
+from ..types.wire import InvalidRequestError, KLLMsError, RateLimitError
+from ..utils import observability as _obs
+from . import sse
+
+logger = logging.getLogger(__name__)
+
+# Request-body keys forwarded to Completions.create. Anything else in the
+# payload is ignored (OpenAI semantics: unknown fields don't fail requests).
+_CREATE_KEYS = (
+    "messages", "model", "n", "temperature", "max_tokens", "top_p",
+    "frequency_penalty", "presence_penalty", "stop", "seed",
+    "response_format", "timeout", "logprobs", "top_logprobs", "logit_bias",
+)
+
+_COUNTER_GROUPS = (
+    ("failure", "FAILURE_EVENTS"),
+    ("spec", "SPEC_EVENTS"),
+    ("recovery", "RECOVERY_EVENTS"),
+    ("route", "ROUTE_EVENTS"),
+    ("hedge", "HEDGE_EVENTS"),
+    ("failover", "FAILOVER_EVENTS"),
+    ("quarantine", "QUARANTINE_EVENTS"),
+    ("serve", "SERVE_EVENTS"),
+    ("stream", "STREAM_EVENTS"),
+)
+
+
+class ServingApp:
+    """ASGI 3 application over one KLLMs client."""
+
+    def __init__(self, client: Any) -> None:
+        self.client = client
+
+    # -- ASGI entry --------------------------------------------------------
+    async def __call__(self, scope, receive, send) -> None:
+        if scope["type"] == "lifespan":
+            await self._lifespan(receive, send)
+            return
+        if scope["type"] != "http":  # pragma: no cover - websockets etc.
+            return
+        method, path = scope["method"], scope["path"]
+        try:
+            if method == "POST" and path == "/v1/chat/completions":
+                await self._chat(scope, receive, send)
+            elif method == "GET" and path == "/healthz":
+                await self._healthz(send)
+            elif method == "GET" and path == "/metrics":
+                await self._metrics(send)
+            else:
+                _obs.SERVE_EVENTS.record("request.unknown.404")
+                await _send_json(
+                    send, 404,
+                    _error_body("not found", "invalid_request_error", "not_found"),
+                )
+        except ClientDisconnected:
+            _obs.SERVE_EVENTS.record("request.disconnect")
+        except Exception:  # pragma: no cover - last-resort 500
+            logger.exception("unhandled error serving %s %s", method, path)
+            try:
+                await _send_json(
+                    send, 500,
+                    _error_body("internal server error", "server_error", None),
+                )
+            except Exception:
+                pass
+
+    async def _lifespan(self, receive, send) -> None:
+        while True:
+            message = await receive()
+            if message["type"] == "lifespan.startup":
+                await send({"type": "lifespan.startup.complete"})
+            elif message["type"] == "lifespan.shutdown":
+                await asyncio.to_thread(self._drain)
+                await send({"type": "lifespan.shutdown.complete"})
+                return
+
+    def _drain(self) -> None:
+        backend = getattr(self.client, "backend", None)
+        drain = getattr(backend, "drain", None)
+        if callable(drain):
+            drain()
+
+    # -- GET /healthz ------------------------------------------------------
+    async def _healthz(self, send) -> None:
+        backend = getattr(self.client, "backend", None)
+        health = getattr(backend, "health", None)
+        snap = await asyncio.to_thread(health) if callable(health) else {
+            "state": "ready"
+        }
+        state = str(snap.get("state", "ready"))
+        # Load-balancer semantics: 200 only while this replica ADMITS work.
+        # DEGRADED still serves (at reduced width); RECOVERING/DRAINING/
+        # STOPPED reject, so health checks must route traffic away.
+        status = 200 if state in ("ready", "degraded") else 503
+        _obs.SERVE_EVENTS.record(f"request.healthz.{status}")
+        await _send_json(send, status, snap)
+
+    # -- GET /metrics ------------------------------------------------------
+    async def _metrics(self, send) -> None:
+        lines: List[str] = []
+        for group, attr in _COUNTER_GROUPS:
+            counters = getattr(_obs, attr, None)
+            if counters is None:
+                continue
+            for event, count in sorted(counters.snapshot().items()):
+                lines.append(
+                    f'kllms_{group}_events_total{{event="{event}"}} {count}'
+                )
+        backend = getattr(self.client, "backend", None)
+        cont = getattr(backend, "_continuous", None)
+        if cont is not None:
+            for key, val in sorted(cont.stats.items()):
+                lines.append(f"kllms_continuous_{key} {val}")
+        body = ("\n".join(lines) + "\n").encode()
+        _obs.SERVE_EVENTS.record("request.metrics.200")
+        await _send_bytes(send, 200, body, content_type=b"text/plain; version=0.0.4")
+
+    # -- POST /v1/chat/completions ----------------------------------------
+    async def _chat(self, scope, receive, send) -> None:
+        body = await _read_body(receive)
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            _obs.SERVE_EVENTS.record("request.chat.400")
+            await _send_json(
+                send, 400,
+                _error_body(f"invalid JSON body: {e}", "invalid_request_error", None),
+            )
+            return
+        messages = payload.get("messages")
+        if not isinstance(messages, list) or not messages:
+            _obs.SERVE_EVENTS.record("request.chat.400")
+            await _send_json(
+                send, 400,
+                _error_body(
+                    "'messages' must be a non-empty list",
+                    "invalid_request_error", None, param="messages",
+                ),
+            )
+            return
+        stream = bool(payload.get("stream", False))
+        params = {k: payload[k] for k in _CREATE_KEYS if payload.get(k) is not None}
+
+        # Fault injection at the front door. raise/sleep actions fire inside;
+        # a returned ``disconnect`` spec simulates the client dropping the
+        # connection after the first streamed delta (see module docstring).
+        try:
+            spec = _failpoints.fire("serving.request")
+        except Exception as e:
+            await self._send_error(send, e, route="chat")
+            return
+        simulate_disconnect = (
+            spec is not None and getattr(spec, "action", None) == "disconnect"
+        )
+
+        if not stream:
+            try:
+                completion = await asyncio.to_thread(
+                    self.client.chat.completions.create, **params
+                )
+            except Exception as e:
+                await self._send_error(send, e, route="chat")
+                return
+            _obs.SERVE_EVENTS.record("request.chat.200")
+            await _send_json(send, 200, completion.model_dump(mode="json"))
+            return
+
+        await self._chat_stream(receive, send, params, simulate_disconnect)
+
+    async def _chat_stream(
+        self, receive, send, params: Dict[str, Any], simulate_disconnect: bool
+    ) -> None:
+        try:
+            stream_obj = await asyncio.to_thread(
+                self.client.chat.completions.create, stream=True, **params
+            )
+        except Exception as e:
+            await self._send_error(send, e, route="chat")
+            return
+        _obs.STREAM_EVENTS.record("streams.opened")
+
+        loop = asyncio.get_running_loop()
+        queue: "asyncio.Queue[Tuple[str, Any]]" = asyncio.Queue()
+
+        def _pump() -> None:
+            # The ChatCompletionStream iterator blocks on the decode; pump it
+            # on a worker thread and relay into the event loop.
+            try:
+                for event in stream_obj:
+                    loop.call_soon_threadsafe(queue.put_nowait, ("event", event))
+                loop.call_soon_threadsafe(queue.put_nowait, ("end", None))
+            except Exception as e:  # surfaced as an SSE error event
+                loop.call_soon_threadsafe(queue.put_nowait, ("error", e))
+
+        threading.Thread(target=_pump, daemon=True, name="sse-pump").start()
+
+        disconnect_task = asyncio.ensure_future(_wait_disconnect(receive))
+        started = False
+        deltas_sent = 0
+        try:
+            while True:
+                get_task = asyncio.ensure_future(queue.get())
+                done, _ = await asyncio.wait(
+                    {get_task, disconnect_task},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if disconnect_task in done:
+                    get_task.cancel()
+                    await self._abort_stream(stream_obj, "client disconnected")
+                    return
+                kind, value = get_task.result()
+                if kind == "error":
+                    e = value
+                    if not started:
+                        await self._send_error(send, e, route="chat")
+                    else:
+                        # Headers are on the wire; the error rides the stream.
+                        wire = (
+                            e.as_wire()["error"]
+                            if isinstance(e, KLLMsError)
+                            else {"message": str(e), "type": "server_error"}
+                        )
+                        await send({
+                            "type": "http.response.body",
+                            "body": sse.format_event({"error": wire}) + sse.DONE,
+                            "more_body": False,
+                        })
+                    _obs.STREAM_EVENTS.record("streams.aborted")
+                    return
+                if kind == "end":
+                    await send({
+                        "type": "http.response.body",
+                        "body": sse.DONE,
+                        "more_body": False,
+                    })
+                    _obs.STREAM_EVENTS.record("streams.completed")
+                    _obs.SERVE_EVENTS.record("request.chat.200")
+                    return
+                event = value
+                if not started:
+                    await send({
+                        "type": "http.response.start",
+                        "status": 200,
+                        "headers": list(sse.HEADERS),
+                    })
+                    started = True
+                await send({
+                    "type": "http.response.body",
+                    "body": sse.format_event(event),
+                    "more_body": True,
+                })
+                if event.get("object") == "chat.completion.chunk":
+                    if event["choices"][0]["delta"].get("content"):
+                        _obs.STREAM_EVENTS.record("tokens.streamed")
+                    deltas_sent += 1
+                if simulate_disconnect and deltas_sent >= 1:
+                    # Injected client drop: behave exactly as if http.disconnect
+                    # arrived now — cancel the decode, stop writing.
+                    _obs.SERVE_EVENTS.record("request.disconnect")
+                    await self._abort_stream(
+                        stream_obj, "injected disconnect (failpoint)",
+                        record_disconnect=False,
+                    )
+                    await send({
+                        "type": "http.response.body",
+                        "body": b"",
+                        "more_body": False,
+                    })
+                    return
+        finally:
+            if not disconnect_task.done():
+                disconnect_task.cancel()
+
+    async def _abort_stream(
+        self, stream_obj, reason: str, record_disconnect: bool = True
+    ) -> None:
+        if record_disconnect:
+            _obs.SERVE_EVENTS.record("request.disconnect")
+        _obs.STREAM_EVENTS.record("streams.aborted")
+        logger.info("aborting stream: %s", reason)
+        # close() cancels the stream's budget; the engine's abort poller (or
+        # the continuous loop's budget check) then retires the decode rows.
+        await asyncio.to_thread(stream_obj.close)
+
+    async def _send_error(self, send, e: Exception, route: str) -> None:
+        if isinstance(e, KLLMsError):
+            status = e.status_code
+            body = e.as_wire()  # already the full {"error": {...}} envelope
+        else:
+            logger.exception("request failed")
+            status = 500
+            body = _error_body(str(e) or "internal server error", "server_error", None)
+        headers: List[Tuple[bytes, bytes]] = []
+        if isinstance(e, RateLimitError) and e.retry_after is not None:
+            headers.append((b"retry-after", str(max(1, int(e.retry_after))).encode()))
+        _obs.SERVE_EVENTS.record(f"request.{route}.{status}")
+        await _send_json(send, status, body, extra_headers=headers)
+
+
+def create_app(
+    client: Optional[Any] = None, **client_kwargs: Any
+) -> ServingApp:
+    """Build the app, constructing a KLLMs client when one isn't supplied."""
+    if client is None:
+        from ..client import KLLMs
+
+        client = KLLMs(**client_kwargs)
+    return ServingApp(client)
+
+
+# -- ASGI plumbing ---------------------------------------------------------
+class ClientDisconnected(Exception):
+    pass
+
+
+async def _read_body(receive) -> bytes:
+    chunks: List[bytes] = []
+    while True:
+        message = await receive()
+        if message["type"] == "http.disconnect":
+            raise ClientDisconnected()
+        chunks.append(message.get("body", b""))
+        if not message.get("more_body", False):
+            return b"".join(chunks)
+
+
+async def _wait_disconnect(receive) -> None:
+    while True:
+        message = await receive()
+        if message["type"] == "http.disconnect":
+            return
+
+
+def _error_body(
+    message: str, err_type: str, code: Optional[str], param: Optional[str] = None
+) -> Dict[str, Any]:
+    return {
+        "error": {"message": message, "type": err_type, "param": param, "code": code}
+    }
+
+
+async def _send_bytes(
+    send, status: int, body: bytes,
+    content_type: bytes = b"application/json",
+    extra_headers: Optional[List[Tuple[bytes, bytes]]] = None,
+) -> None:
+    headers = [
+        (b"content-type", content_type),
+        (b"content-length", str(len(body)).encode()),
+    ]
+    headers.extend(extra_headers or [])
+    await send({"type": "http.response.start", "status": status, "headers": headers})
+    await send({"type": "http.response.body", "body": body})
+
+
+async def _send_json(
+    send, status: int, obj: Any,
+    extra_headers: Optional[List[Tuple[bytes, bytes]]] = None,
+) -> None:
+    await _send_bytes(
+        send, status, json.dumps(obj, separators=(",", ":")).encode(),
+        extra_headers=extra_headers,
+    )

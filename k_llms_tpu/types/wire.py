@@ -162,6 +162,27 @@ class KLLMsError(Exception):
         }
 
 
+class InvalidRequestError(KLLMsError):
+    """Caller error: a parameter the backend cannot honor (e.g. ``stream=True``
+    on a backend with no streaming path) or a malformed request body. OpenAI's
+    ``invalid_request_error`` wire shape, HTTP 400. ``param`` names the
+    offending field when known, so the wire body points at it."""
+
+    type = "invalid_request_error"
+    status_code = 400
+
+    def __init__(self, message: str, param: Optional[str] = None, code: Optional[str] = None):
+        super().__init__(message)
+        self.param = param
+        if code is not None:
+            self.code = code
+
+    def as_wire(self) -> Dict[str, Any]:
+        body = super().as_wire()
+        body["error"]["param"] = self.param
+        return body
+
+
 class RequestTimeoutError(KLLMsError):
     """Deadline exceeded — queued past its deadline, or cancelled at token
     granularity mid-decode (openai.APITimeoutError's wire shape)."""

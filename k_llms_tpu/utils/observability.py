@@ -129,6 +129,20 @@ FAILOVER_EVENTS = EventCounters()
 QUARANTINE_EVENTS = EventCounters()
 
 
+#: Process-wide HTTP-serving counters (request.<route>.<status> — one per
+#: completed request keyed by route and HTTP status, plus request.disconnect
+#: for clients that dropped before the response finished), fed by the ASGI
+#: app in ``serving/app.py`` and surfaced verbatim on ``/metrics``.
+SERVE_EVENTS = EventCounters()
+
+#: Process-wide SSE-streaming counters (streams.opened, streams.completed,
+#: streams.aborted — closed before the final consensus event, whether by
+#: client disconnect or a mid-stream error — and tokens.streamed, the count
+#: of delta chunks put on the wire). streams.aborted / streams.opened is the
+#: stream-survival rate operators watch during deploys.
+STREAM_EVENTS = EventCounters()
+
+
 def _walk_confidences(node: Any, out: List[float]) -> None:
     if isinstance(node, dict):
         for v in node.values():

@@ -1,0 +1,211 @@
+"""Continuous in-flight batching (engine/continuous.py): the persistent
+W-slot decode loop behind the serving path's streaming mode.
+
+The load-bearing pins: a late request JOINS a decode already in flight (the
+whole point — no waiting behind coalesced groups), sampling is
+self-deterministic regardless of batch composition, budget cancellation
+retires slot rows through the same ``engine.decode_abort`` accounting as the
+batch path, and the TpuBackend routes only qualifying requests to the loop.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from k_llms_tpu.engine.continuous import ContinuousDecodeLoop
+from k_llms_tpu.reliability.deadline import RequestBudget
+from k_llms_tpu.types.wire import RequestCancelledError
+from k_llms_tpu.utils.observability import FAILURE_EVENTS
+
+
+@pytest.fixture(scope="module")
+def loop():
+    from conftest import shared_engine
+
+    eng = shared_engine(model="tiny")
+    lp = ContinuousDecodeLoop(eng, width=4, max_prompt=64, max_new=32)
+    yield lp
+    lp.stop()
+
+
+def test_basic_generation_and_sink_order(loop):
+    sunk = []
+    fut = loop.submit(
+        [1, 2, 3, 4, 5], n=2, max_new=8, temperature=0.7, top_p=0.9, seed=7,
+        token_sink=lambda step, toks: sunk.append((step, toks.copy())),
+    )
+    result = fut.result(timeout=120)
+    assert result.tokens.shape == (2, 8)
+    assert list(result.lengths) == [8, 8] or all(
+        fin in ("stop", "length") for fin in result.finish_reasons
+    )
+    # Sink delivery is strictly in step order and bit-identical to the final
+    # buffers (the host drives the loop, so there is no reorder window).
+    assert [s for s, _ in sunk] == list(range(len(sunk)))
+    for step, row in sunk:
+        for j in range(2):
+            if step < result.lengths[j]:
+                assert row[j] == result.tokens[j, step]
+
+
+def test_self_deterministic_across_batch_composition(loop):
+    """Same seed → same tokens, whether the request ran alone or beside
+    others — row keys derive from (seed, step, sample), not slot position."""
+    a = loop.submit(
+        [1, 2, 3, 4, 5], n=2, max_new=8, temperature=0.7, top_p=0.9, seed=21
+    ).result(timeout=120)
+    # Re-run with a neighbor occupying other slots.
+    noise = loop.submit(
+        [9, 8, 7], n=2, max_new=16, temperature=1.0, top_p=0.95, seed=4
+    )
+    b = loop.submit(
+        [1, 2, 3, 4, 5], n=2, max_new=8, temperature=0.7, top_p=0.9, seed=21
+    ).result(timeout=120)
+    noise.result(timeout=120)
+    assert np.array_equal(a.tokens, b.tokens)
+    assert np.allclose(a.logprobs, b.logprobs, atol=1e-5)
+
+
+def test_greedy_matches_batch_engine(loop):
+    """temperature=0 through the slot loop reproduces the batch decode loop's
+    greedy tokens — same model, same argmax, different orchestration."""
+    cont = loop.submit(
+        [1, 2, 3, 4, 5], n=1, max_new=8, temperature=0.0, top_p=None, seed=3
+    ).result(timeout=120)
+    batch = loop.engine.generate(
+        [1, 2, 3, 4, 5], n=1, max_new_tokens=8, temperature=0.0, seed=3
+    )
+    nc, nb = int(cont.lengths[0]), int(batch.lengths[0])
+    assert np.array_equal(cont.tokens[0][:nc], batch.tokens[0][:nb])
+
+
+def test_late_request_joins_in_flight_decode(loop):
+    """Acceptance pin: a request submitted while another is mid-decode starts
+    decoding before the first finishes (joined_in_flight increments and the
+    active row count covers both requests at once)."""
+    base_joined = loop.stats["joined_in_flight"]
+    holder = {}
+
+    def sink(step, _toks):
+        # Deterministic mid-flight arrival: B is submitted the moment A's
+        # first token lands, long before A's 32 steps finish.
+        if step == 0 and "b" not in holder:
+            holder["b"] = loop.submit(
+                [4, 5, 6], n=1, max_new=4, temperature=0.8, top_p=0.95, seed=12
+            )
+
+    a = loop.submit(
+        [1, 2, 3], n=2, max_new=32, temperature=0.8, top_p=0.95, seed=11,
+        token_sink=sink,
+    ).result(timeout=120)
+    b = holder["b"].result(timeout=120)
+    assert a.tokens.shape[0] == 2 and b.tokens.shape[0] == 1
+    assert loop.stats["joined_in_flight"] > base_joined
+    assert loop.stats["max_active_rows"] >= 3
+    # Occupancy accounting is coherent: row_steps never exceeds steps * W.
+    assert 0 < loop.stats["row_steps"] <= loop.stats["steps"] * loop.width
+
+
+def test_budget_abort_retires_rows(loop):
+    budget = RequestBudget()
+    before = FAILURE_EVENTS.snapshot().get("engine.decode_abort", 0)
+    fut = loop.submit(
+        [1, 2, 3, 4], n=1, max_new=32, temperature=0.9, top_p=0.9, seed=5,
+        budget=budget,
+    )
+    time.sleep(0.02)
+    budget.cancel()
+    with pytest.raises(RequestCancelledError):
+        fut.result(timeout=120)
+    assert FAILURE_EVENTS.snapshot().get("engine.decode_abort", 0) > before
+    # Slots freed: a follow-up request still runs.
+    ok = loop.submit(
+        [1, 2], n=1, max_new=4, temperature=0.0, top_p=None, seed=1
+    ).result(timeout=120)
+    assert int(ok.lengths[0]) > 0
+
+
+def test_qualification_bounds(loop):
+    assert loop.qualifies(10, 2, 16)
+    assert not loop.qualifies(10, loop.width + 1, 16)  # too many samples
+    assert not loop.qualifies(loop.max_prompt + 1, 1, 16)  # prompt too long
+    assert not loop.qualifies(10, 1, loop.max_new + 1)  # too many new tokens
+
+
+def test_backend_routes_qualifying_requests_to_loop():
+    """TpuBackend with continuous_batching=True serves plain sampling through
+    the slot loop (stats move) but keeps constrained requests on the
+    coalescing scheduler (the loop never sees them)."""
+    import jax
+    from conftest import shared_engine
+
+    from k_llms_tpu import KLLMs
+    from k_llms_tpu.backends.tpu import TpuBackend
+
+    engine = (
+        shared_engine("tiny", mesh_shape=(8, 1)) if len(jax.devices()) == 8 else None
+    )
+    backend = TpuBackend(
+        model="tiny", max_new_tokens=8, engine=engine,
+        continuous_batching=True, continuous_width=4,
+        continuous_max_prompt=128, continuous_max_new=64,
+    )
+    client = KLLMs(backend=backend, model="tiny")
+    msgs = [{"role": "user", "content": "hello"}]
+
+    r = client.chat.completions.create(messages=msgs, model="tiny", n=2, seed=9)
+    assert len(r.choices) == 3
+    assert backend._continuous.stats["admitted"] == 1
+
+    # json_object response_format needs the constraint machinery → coalescing
+    # path; the loop's admission count must NOT move.
+    r2 = client.chat.completions.create(
+        messages=msgs, model="tiny", n=1, seed=9, max_tokens=4,
+        response_format={"type": "json_object"},
+    )
+    assert r2.choices
+    assert backend._continuous.stats["admitted"] == 1
+
+    # health() surfaces the loop; drain() quiesces it and closes admission.
+    assert backend.health()["continuous"]["completed"] >= 1
+    assert backend.drain(timeout=30)
+    from k_llms_tpu.types.wire import BackendUnavailableError, ServerDrainingError
+
+    with pytest.raises((ServerDrainingError, BackendUnavailableError)):
+        client.chat.completions.create(messages=msgs, model="tiny")
+    client.close()
+
+
+def test_continuous_determinism_matches_nonstream_through_backend():
+    """The SAME request through the continuous loop with and without a token
+    sink yields identical choices — streaming must not perturb sampling."""
+    import jax
+    from conftest import shared_engine
+
+    from k_llms_tpu import KLLMs
+    from k_llms_tpu.backends.tpu import TpuBackend
+
+    engine = (
+        shared_engine("tiny", mesh_shape=(8, 1)) if len(jax.devices()) == 8 else None
+    )
+    backend = TpuBackend(
+        model="tiny", max_new_tokens=8, engine=engine,
+        continuous_batching=True, continuous_width=4,
+        continuous_max_prompt=128, continuous_max_new=64,
+    )
+    client = KLLMs(backend=backend, model="tiny")
+    msgs = [{"role": "user", "content": "stream parity"}]
+    plain = client.chat.completions.create(
+        messages=msgs, model="tiny", n=2, seed=33, temperature=0.8
+    )
+    with client.chat.completions.create(
+        messages=msgs, model="tiny", n=2, seed=33, temperature=0.8, stream=True
+    ) as stream:
+        for _ in stream:
+            pass
+        streamed = stream.response
+    assert [c.message.content for c in plain.choices] == [
+        c.message.content for c in streamed.choices
+    ]
+    client.close()
