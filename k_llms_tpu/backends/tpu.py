@@ -166,6 +166,20 @@ class BackendConfig(BaseModel):
     # the coalescing path.
     continuous_max_prompt: int = 512
     continuous_max_new: int = 256
+    # -- paged KV cache (PR 7) --------------------------------------------
+    # Paged layout for the continuous loop's KV: a fixed pool of fixed-size
+    # pages with per-row block tables; an n-way fan-out's rows SHARE the
+    # prompt pages (refcounted, copy-on-write at the first divergent token)
+    # instead of holding n dense copies, so admitted width at equal HBM
+    # scales with the fan-out. Dense per-slot caches remain the fallback.
+    paged_kv: bool = True
+    # Tokens per KV page. Smaller pages waste less on partial fills but grow
+    # the block tables; 64 matches the gather granularity the paged step
+    # compiles well at.
+    kv_page_size: int = 64
+    # Total pool pages. None = sized by the continuous loop from its own
+    # width/prompt/new bounds (worst-case no-sharing occupancy plus slack).
+    kv_pool_pages: Optional[int] = None
 
 
 def _detect_hbm_bytes() -> Optional[int]:
@@ -240,6 +254,29 @@ class HbmMemoryModel:
         seq_len = max(1, int(seq_len))
         per_row = (
             seq_len * self.kv_bytes_per_token // self.tp + self.row_margin_bytes
+        )
+        rows = self.dp * max(0, self.budget_bytes()) // max(1, per_row)
+        return max(1, int(rows))
+
+    def paged_max_rows(
+        self, prompt_len: int, max_new: int, page_size: int, fanout: int = 1
+    ) -> int:
+        """Row cap when rows hold paged KV and every ``fanout`` rows share
+        one prompt's pages: per-row cost is the private generation reserve
+        plus ``1/fanout`` of the shared prompt pages. At ``fanout == 1`` this
+        is :meth:`max_rows` up to page-granularity rounding; at high fan-out
+        the prompt term amortizes away and admitted width scales ~n x."""
+        ps = max(1, int(page_size))
+        fanout = max(1, int(fanout))
+        prompt_len = max(1, int(prompt_len))
+        max_new = max(1, int(max_new))
+        page_bytes = ps * self.kv_bytes_per_token // self.tp
+        prompt_pages = -(-prompt_len // ps)
+        reserve = (prompt_len + max_new - 1) // ps - prompt_len // ps + 1
+        per_row = (
+            reserve * page_bytes
+            + -(-prompt_pages * page_bytes // fanout)
+            + self.row_margin_bytes
         )
         rows = self.dp * max(0, self.budget_bytes()) // max(1, per_row)
         return max(1, int(rows))
@@ -445,12 +482,21 @@ class TpuBackend(Backend):
         from ..engine.continuous import ContinuousDecodeLoop
 
         cfg = self.backend_config
-        width = min(
-            cfg.continuous_width,
-            self.memory_model.max_rows(
+        if getattr(self.engine, "kv_layout", "dense") == "paged":
+            # Paged rows share prompt pages across a fan-out; clamp against
+            # the amortized cost at the loop's own width (the fan-out bound)
+            # so shared-prefix requests aren't under-admitted by dense math.
+            cap = self.memory_model.paged_max_rows(
+                cfg.continuous_max_prompt,
+                cfg.continuous_max_new,
+                self.engine.kv_page_size,
+                fanout=cfg.continuous_width,
+            )
+        else:
+            cap = self.memory_model.max_rows(
                 cfg.continuous_max_prompt + cfg.continuous_max_new
-            ),
-        )
+            )
+        width = min(cfg.continuous_width, cap)
         return ContinuousDecodeLoop(
             self.engine,
             width=max(1, width),
@@ -490,6 +536,9 @@ class TpuBackend(Backend):
             prefix_cache_min_reuse=cfg.prefix_cache_min_reuse,
             speculative=cfg.speculative,
             spec_lookahead=cfg.spec_lookahead,
+            kv_layout="paged" if cfg.paged_kv else "dense",
+            kv_page_size=cfg.kv_page_size,
+            kv_pool_pages=cfg.kv_pool_pages,
         )
 
     def _wire_engine_hooks(self) -> None:
@@ -999,6 +1048,22 @@ class TpuBackend(Backend):
         snap["params"] = self.param_summary
         if self._continuous is not None:
             snap["continuous"] = dict(self._continuous.stats)
+        # HBM accounting: params + per-token KV, and — when the engine runs
+        # the paged layout — the live page-pool occupancy (reading the pool
+        # stats through the loop's stats property also re-checks the page
+        # conservation invariants).
+        hbm: Dict[str, Any] = {
+            "param_bytes": self.memory_model.param_bytes,
+            "kv_bytes_per_token": self.memory_model.kv_bytes_per_token,
+            "budget_bytes": self.memory_model.budget_bytes(),
+            "paged": getattr(self.engine, "kv_layout", "dense") == "paged",
+            "page_size": getattr(self.engine, "kv_page_size", None),
+        }
+        pool = getattr(self.engine, "_kv_pool", None)
+        if pool is not None:
+            hbm["page_pool"] = pool.allocator.snapshot()
+            hbm["page_pool_bytes"] = pool.pool_bytes()
+        snap["hbm"] = hbm
         return snap
 
     def drain(self, timeout: Optional[float] = None) -> bool:

@@ -55,12 +55,13 @@ import jax.numpy as jnp
 import numpy as np
 from concurrent.futures import Future
 
-from ..models.llama import KVCache, init_cache, verify_step
+from ..models.llama import KVCache, init_cache, paged_verify_step, verify_step
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, ServerDrainingError
 from ..utils.observability import FAILURE_EVENTS
 from .engine import GenerationResult, is_resource_exhausted
+from .paging import TRASH_PAGE, PagePoolExhausted, flat_slots, pages_for
 
 logger = logging.getLogger(__name__)
 
@@ -131,8 +132,31 @@ class ContinuousDecodeLoop:
         self._step_fn = None
         self._write_prefix_fn = None
         self._sample_rows_fn = None
+        self._built = False
+        # PAGED slot state: the loop follows the engine's KV layout. Instead
+        # of dense per-slot caches, each slot holds a block TABLE of pool page
+        # ids (prompt pages shared across a request's n rows, refcounted;
+        # generation pages private, pre-reserved at admission so a mid-flight
+        # step can never fail on allocation) plus host index mirrors the
+        # jitted paged step consumes.
+        self.paged = getattr(engine, "kv_layout", "dense") == "paged"
+        self._pool = None
+        self._tables: List[List[int]] = [[] for _ in range(self.width)]
+        self._reserved: List[List[int]] = [[] for _ in range(self.width)]
+        self._prefix_idx = np.zeros((self.width, self.max_prompt), np.int32)
+        self._gen_idx = np.zeros((self.width, self.max_new), np.int32)
+        self._step_paged_fn = None
+        if self.paged:
+            pool = getattr(engine, "_kv_pool", None)
+            self._pool_pages_planned = (
+                pool.allocator.total_pages
+                if pool is not None
+                else int(engine.kv_pool_pages or self._default_pool_pages())
+            )
+        else:
+            self._pool_pages_planned = 0
         # Stats (reported via backend health() and the bench workload).
-        self.stats: Dict[str, Any] = {
+        self._stats: Dict[str, Any] = {
             "steps": 0,
             "row_steps": 0,
             "admitted": 0,
@@ -143,15 +167,53 @@ class ContinuousDecodeLoop:
         }
         self._thread: Optional[threading.Thread] = None
 
+    def _default_pool_pages(self) -> int:
+        """Pool sizing when neither the engine nor the backend pinned one:
+        every slot decoding a DISTINCT max-shape prompt (the no-sharing worst
+        case), plus one reserve page per slot for CoW, a couple of prompt-size
+        runs of prefix-cache slack, and the trash page."""
+        ps = self.engine.kv_page_size
+        per_slot = pages_for(self.max_prompt + self.max_new, ps) + 1
+        return self.width * per_slot + 2 * pages_for(self.max_prompt, ps) + 1
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """Loop counters — and, in paged mode, the page-pool snapshot behind a
+        conservation-invariant check (:meth:`PageAllocator.verify`): every
+        ``health()`` read doubles as a fail-fast page-accounting audit, so a
+        leaked or double-freed page surfaces at the next poll instead of as
+        silent corruption."""
+        out = dict(self._stats)
+        if self.paged and self._pool is not None:
+            with self._lock:
+                self._pool.allocator.verify()
+                held = sum(len(t) for t in self._tables) + sum(
+                    len(r) for r in self._reserved
+                )
+                out["pages"] = {
+                    **self._pool.allocator.snapshot(),
+                    "loop_refs": held,
+                }
+        return out
+
     # -- public API --------------------------------------------------------
 
     def qualifies(self, prompt_len: int, n: int, max_new: int) -> bool:
         """Can this request shape run in the shared loop at all?"""
-        return (
+        ok = (
             n <= self.width
             and prompt_len <= self.max_prompt
             and max_new <= self.max_new
         )
+        if ok and self.paged:
+            # Peak page demand for this request alone must fit the pool even
+            # with the prefix cache fully evicted: one shared prompt run plus
+            # n private generation reserves (minus the trash page).
+            ps = self.engine.kv_page_size
+            reserve = (prompt_len + max_new - 1) // ps - prompt_len // ps + 1
+            need = pages_for(prompt_len, ps) + max(1, n) * reserve
+            ok = need <= self._pool_pages_planned - 1
+        return ok
 
     def submit(
         self,
@@ -245,8 +307,16 @@ class ContinuousDecodeLoop:
     def _build_device_state(self) -> None:
         config = self.engine.config
         W, P, G = self.width, self.max_prompt, self.max_new
-        self._prefix = init_cache(config, W, P)
-        self._gen = init_cache(config, W, G)
+        if self.paged:
+            # One flat KV pool instead of dense per-slot caches; the engine
+            # owns it so prefix-cache page runs and loop rows share pages.
+            self._pool = self.engine._ensure_kv_pool(
+                min_pages=self._pool_pages_planned
+            )
+            self._pool_pages_planned = self._pool.allocator.total_pages
+        else:
+            self._prefix = init_cache(config, W, P)
+            self._gen = init_cache(config, W, G)
 
         pad_id = config.pad_token_id
         # pad must stay unsampleable on live rows unless the tokenizer maps
@@ -326,6 +396,29 @@ class ContinuousDecodeLoop:
 
         self._admit_sample_fn = jax.jit(_admit_sample)
 
+        def _step_paged(params, pool_k, pool_v, cur, gen_lens, prompt_lens,
+                        active, seeds, sample_idx, temps, top_ps, prefix_idx,
+                        gen_idx, write_idx):
+            # Paged twin of _step: rows read their KV through block-table
+            # gathers into the shared pool and write cur's column back at a
+            # host-computed flat slot. Same masks, same sampler, same key
+            # schedule — byte-identical tokens to the dense loop.
+            logits, k_cols, v_cols = paged_verify_step(
+                config, params, cur[:, None], gen_lens, prompt_lens,
+                KVCache(k=pool_k, v=pool_v), prefix_idx, gen_idx,
+            )
+            pool_k = pool_k.at[:, write_idx].set(k_cols.astype(pool_k.dtype))
+            pool_v = pool_v.at[:, write_idx].set(v_cols.astype(pool_v.dtype))
+            logits = _mask_pad(logits[:, 0, :])
+            keys = _row_keys(seeds, gen_lens + 1, sample_idx)
+            tok, lp = _sample_rows(logits, keys, temps, top_ps)
+            tok = jnp.where(active, tok, jnp.int32(pad_id))
+            lp = jnp.where(active, lp, 0.0)
+            return tok, lp, pool_k, pool_v
+
+        self._step_paged_fn = jax.jit(_step_paged, donate_argnums=(1, 2))
+        self._built = True
+
     # -- worker ------------------------------------------------------------
 
     def _ensure_worker(self) -> None:
@@ -388,7 +481,7 @@ class ContinuousDecodeLoop:
                 FAILURE_EVENTS.record("scheduler.shed")
                 req.future.set_exception(req.budget.error("continuous queue"))
                 continue
-            if self._prefix is None:
+            if not self._built:
                 self._build_device_state()
             in_flight = self._active_mask.any()
             rows = [self._free.pop(0) for _ in range(req.n)]
@@ -396,30 +489,55 @@ class ContinuousDecodeLoop:
             try:
                 self._admit_device(req, rows, ids, prompt_len, seed,
                                    temperature, top_p)
+            except PagePoolExhausted as e:
+                # Pages are a transient resource: in-flight rows free theirs
+                # as they retire, so park the head request and retry after the
+                # next step instead of failing it. With nothing in flight the
+                # pool genuinely cannot fit the request — fail it to avoid a
+                # head-of-line deadlock (qualifies() makes this unreachable
+                # for well-sized pools).
+                for r in rows:
+                    self._free.append(r)
+                req.slots = []
+                if in_flight:
+                    self._pending_prefill[id(req)] = (
+                        ids, prompt_len, seed, temperature, top_p
+                    )
+                    self._queue.appendleft(req)
+                    break
+                req.future.set_exception(BackendUnavailableError(
+                    f"paged KV pool cannot fit request: {e}"
+                ))
+                continue
             except Exception as e:
                 for r in rows:
                     self._free.append(r)
                 req.future.set_exception(e)
                 continue
-            self.stats["admitted"] += 1
+            self._stats["admitted"] += 1
             if in_flight:
-                self.stats["joined_in_flight"] += 1
+                self._stats["joined_in_flight"] += 1
 
     def _admit_device(self, req, rows, ids, prompt_len, seed, temperature,
                       top_p) -> None:
         engine = self.engine
         _ids, _plen, bucket = engine._prep_prompt(ids)
-        first_logits, prefix = engine._prefill_routed(_ids, _plen, bucket)
-        pk, pv = prefix.k, prefix.v
-        if bucket < self.max_prompt:
-            pad = [(0, 0)] * 5
-            pad[2] = (0, self.max_prompt - bucket)
-            pk, pv = jnp.pad(pk, pad), jnp.pad(pv, pad)
-        rows_arr = jnp.asarray(np.asarray(rows, np.int32))
         n = len(rows)
-        rep_k = jnp.broadcast_to(pk[:, 0:1], (pk.shape[0], n) + pk.shape[2:])
-        rep_v = jnp.broadcast_to(pv[:, 0:1], (pv.shape[0], n) + pv.shape[2:])
-        self._prefix = self._write_prefix_fn(self._prefix, rep_k, rep_v, rows_arr)
+        if self.paged:
+            first_logits = self._admit_paged_kv(req, rows, _ids, _plen, bucket)
+        else:
+            first_logits, prefix = engine._prefill_routed(_ids, _plen, bucket)
+            pk, pv = prefix.k, prefix.v
+            if bucket < self.max_prompt:
+                pad = [(0, 0)] * 5
+                pad[2] = (0, self.max_prompt - bucket)
+                pk, pv = jnp.pad(pk, pad), jnp.pad(pv, pad)
+            rows_arr = jnp.asarray(np.asarray(rows, np.int32))
+            rep_k = jnp.broadcast_to(pk[:, 0:1], (pk.shape[0], n) + pk.shape[2:])
+            rep_v = jnp.broadcast_to(pv[:, 0:1], (pv.shape[0], n) + pv.shape[2:])
+            self._prefix = self._write_prefix_fn(
+                self._prefix, rep_k, rep_v, rows_arr
+            )
 
         # First-token sampling at admission (step 0), padded to W rows.
         W = self.width
@@ -459,6 +577,138 @@ class ContinuousDecodeLoop:
         self._retire_finished_rows(req)
         self._resolve_if_done(req)
 
+    # -- paged slot management --------------------------------------------
+
+    def _admit_paged_kv(self, req, rows, _ids, _plen, bucket):
+        """Install one request's prompt KV as shared, refcounted pool pages.
+
+        The prefill's page run is incref'd once per row (the n-way fan-out
+        shares ONE copy of the prompt KV), and each row pre-reserves its
+        private generation pages up front so a mid-flight decode step can
+        never fail on allocation. Copy-on-write of the partially-filled last
+        prompt page happens lazily at each row's first divergent write
+        (:meth:`_prepare_step_pages`). Raises :class:`PagePoolExhausted` with
+        everything rolled back if the reserves don't fit."""
+        engine = self.engine
+        alloc = self._pool.allocator
+        ps = self._pool.page_size
+        first_logits, run, transient = engine.paged_admit_prefix(
+            _ids, _plen, bucket
+        )
+        # Pages the row's writes can touch: gen positions occupy pages
+        # plen//ps .. (plen+max_new-1)//ps; the first of those is the prompt's
+        # partial page (CoW target) when plen % ps != 0, fresh otherwise —
+        # the +1 covers both cases.
+        reserve = (_plen + req.max_new - 1) // ps - _plen // ps + 1
+        new_reserved: List[List[int]] = []
+        try:
+            with engine._paged_mutex:
+                for _ in rows:
+                    alloc.incref(run.pages)
+                try:
+                    for _ in rows:
+                        new_reserved.append(
+                            engine._alloc_pages_with_evict(reserve)
+                        )
+                except BaseException:
+                    for lst in new_reserved:
+                        alloc.decref(lst)
+                    for _ in rows:
+                        alloc.decref(run.pages)
+                    raise
+        finally:
+            if transient:
+                # Uncached prefill: the run was a scratch owner of the prompt
+                # pages; the rows' increfs above now keep them alive.
+                run.release()
+        for j, slot in enumerate(rows):
+            self._tables[slot] = list(run.pages)
+            self._reserved[slot] = new_reserved[j]
+            self._refresh_row_idx(slot, _plen)
+        return first_logits
+
+    def _refresh_row_idx(self, slot: int, plen: Optional[int] = None) -> None:
+        """Rebuild one slot's flat gather indices from its block table. Must
+        run after ANY table change (admit, extension, CoW, release): a stale
+        index could keep gathering a page that was freed and reused."""
+        ps = self._pool.page_size
+        table = self._tables[slot]
+        P, G = self.max_prompt, self.max_new
+        if plen is None:
+            plen = int(self._prompt_lens[slot])
+        pidx = flat_slots(table, np.arange(P), ps)
+        # Positions at/after the prompt end read through gen_idx instead;
+        # point them into the trash page (masked, but must stay in bounds).
+        pidx[plen:] = (np.arange(P - plen) % ps).astype(np.int32)
+        self._prefix_idx[slot] = pidx
+        self._gen_idx[slot] = flat_slots(table, plen + np.arange(G), ps)
+
+    def _prepare_step_pages(self) -> np.ndarray:
+        """Resolve each row's write slot for the upcoming step, performing
+        page-table maintenance on the way: append a reserved page when the
+        write crosses a page boundary, copy-on-write when the target page is
+        still shared with other readers. Returns the [W] flat write indices
+        (inactive rows write into the trash page). Called with the lock held;
+        never allocates — admission reserved every page this can pop."""
+        pool = self._pool
+        ps = pool.page_size
+        alloc = pool.allocator
+        W = self.width
+        write_idx = np.empty((W,), np.int32)
+        cow_src: List[int] = []
+        cow_dst: List[int] = []
+        for slot in range(W):
+            if not self._active_mask[slot]:
+                write_idx[slot] = TRASH_PAGE * ps + slot % ps
+                continue
+            pos = int(self._prompt_lens[slot]) + int(self._gen_lens[slot])
+            page_i = pos // ps
+            table = self._tables[slot]
+            if page_i == len(table):
+                table.append(self._reserved[slot].pop())
+                self._refresh_row_idx(slot)
+            elif alloc.refcount(table[page_i]) > 1:
+                # First divergent write into the shared partial prompt page:
+                # give this row a private copy, then retarget its table.
+                new_page = self._reserved[slot].pop()
+                cow_src.append(table[page_i])
+                cow_dst.append(new_page)
+                table[page_i] = new_page
+                alloc.note_cow()
+                self._refresh_row_idx(slot)
+            write_idx[slot] = table[page_i] * ps + pos % ps
+        if cow_src:
+            # Pad with trash->trash no-ops so every CoW batch shares one
+            # compiled copy program regardless of how many rows diverged.
+            src = list(cow_src)
+            dst = list(cow_dst)
+            while len(src) < W:
+                src.append(TRASH_PAGE)
+                dst.append(TRASH_PAGE)
+            pool.copy_pages(src, dst)
+            # Our reference on each source page must outlive the device copy
+            # that reads it — decref only after the copy is enqueued (the
+            # pool swap orders it before the next step's gathers).
+            alloc.decref(cow_src)
+        return write_idx
+
+    def _release_slot_pages(self, slot: int) -> None:
+        """Drop a retired slot's page references (shared prompt pages survive
+        while the prefix cache or sibling rows still hold them)."""
+        if not self.paged or self._pool is None:
+            return
+        spec = _failpoints.fire("engine.pages")
+        if spec is not None and spec.action == "leak":
+            self._pool.allocator.leak(max(1, int(spec.kill)))
+        alloc = self._pool.allocator
+        table, self._tables[slot] = self._tables[slot], []
+        reserved, self._reserved[slot] = self._reserved[slot], []
+        if table:
+            alloc.decref(table)
+        if reserved:
+            alloc.decref(reserved)
+        self._refresh_row_idx(slot, 0)
+
     def _step_once(self) -> None:
         with self._lock:
             active_reqs = {
@@ -472,16 +722,30 @@ class ContinuousDecodeLoop:
             sidx = jnp.asarray(self._sample_idx)
             temps = jnp.asarray(self._temps)
             tps = jnp.asarray(self._top_ps)
-        tok, lp, self._gen = self._step_fn(
-            self.engine.params, self._prefix, self._gen, cur, gen_lens,
-            prompt_lens, active, seeds, sidx, temps, tps,
-        )
+            if self.paged:
+                write_idx = jnp.asarray(self._prepare_step_pages())
+                pidx = jnp.asarray(self._prefix_idx)
+                gidx = jnp.asarray(self._gen_idx)
+        if self.paged:
+            pool = self._pool
+            with pool.lock:
+                tok, lp, new_k, new_v = self._step_paged_fn(
+                    self.engine.params, pool.kv.k, pool.kv.v, cur, gen_lens,
+                    prompt_lens, active, seeds, sidx, temps, tps, pidx, gidx,
+                    write_idx,
+                )
+                pool.kv = KVCache(k=new_k, v=new_v)
+        else:
+            tok, lp, self._gen = self._step_fn(
+                self.engine.params, self._prefix, self._gen, cur, gen_lens,
+                prompt_lens, active, seeds, sidx, temps, tps,
+            )
         tok_np, lp_np = map(np.asarray, jax.device_get((tok, lp)))
         with self._lock:
-            self.stats["steps"] += 1
-            self.stats["row_steps"] += int(self._active_mask.sum())
-            self.stats["max_active_rows"] = max(
-                self.stats["max_active_rows"], int(self._active_mask.sum())
+            self._stats["steps"] += 1
+            self._stats["row_steps"] += int(self._active_mask.sum())
+            self._stats["max_active_rows"] = max(
+                self._stats["max_active_rows"], int(self._active_mask.sum())
             )
             touched = set()
             for slot in range(self.width):
@@ -545,6 +809,7 @@ class ContinuousDecodeLoop:
                 self._active_mask[slot] = False
                 self._cur[slot] = self.engine.config.pad_token_id
                 self._active[slot] = None
+                self._release_slot_pages(slot)
                 self._free.append(slot)
 
     def _resolve_if_done(self, req: _SlotRequest) -> None:
@@ -575,7 +840,7 @@ class ContinuousDecodeLoop:
             prompt_len=req.prompt_len,
             spec_stats={},
         )
-        self.stats["completed"] += 1
+        self._stats["completed"] += 1
         if not req.future.done():
             req.future.set_result(result)
 
@@ -584,7 +849,7 @@ class ContinuousDecodeLoop:
         for j in range(req.n):
             req.done[j] = True
         self._retire_finished_rows(req)
-        self.stats["aborted"] += 1
+        self._stats["aborted"] += 1
         if not req.future.done():
             req.future.set_exception(req.budget.error("engine decode"))
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import logging
 import os
 import random as _pyrandom
+import threading
 from functools import partial
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -267,6 +268,9 @@ class LocalEngine:
         prefix_cache_min_reuse: int = 32,
         speculative: Optional[str] = None,
         spec_lookahead: int = 4,
+        kv_layout: str = "dense",
+        kv_page_size: int = 64,
+        kv_pool_pages: Optional[int] = None,
     ):
         self.config = get_config(config) if isinstance(config, str) else config
         if mesh is None and use_mesh and len(jax.devices()) > 1:
@@ -416,6 +420,23 @@ class LocalEngine:
             OrderedDict()
         )
         self.prefix_cache_stats = {"hits": 0, "partial_hits": 0, "misses": 0}
+
+        # Paged KV layout (engine/paging.py): prefix-cache entries and the
+        # continuous decode loop's slots hold refcounted PAGES of a fixed pool
+        # instead of dense per-row caches, so an n-way fan-out's shared prompt
+        # is stored once physically. "dense" keeps every path exactly as
+        # before (the config-selected fallback the differential tests compare
+        # against). The pool is built lazily on first paged use.
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(f"Unknown kv_layout {kv_layout!r}; use 'dense' or 'paged'")
+        self.kv_layout = kv_layout
+        self.kv_page_size = int(kv_page_size)
+        self.kv_pool_pages = kv_pool_pages
+        self._kv_pool: Optional[Any] = None
+        # Serializes paged cache-entry/allocator mutation between the
+        # continuous-loop worker and scheduler threads (dense entries are
+        # immutable arrays and never needed this; page refcounts do).
+        self._paged_mutex = threading.RLock()
 
         # Speculative decoding: "prompt_lookup" drafts the next spec_lookahead
         # tokens from the prompt's own text and verifies them in one forward
@@ -699,16 +720,193 @@ class LocalEngine:
         spec = getattr(getattr(kv.k, "sharding", None), "spec", None)
         return bool(spec is not None and len(spec) > 2 and spec[2] == DATA_AXIS)
 
+    # -- paged KV pool -----------------------------------------------------
+
+    def _ensure_kv_pool(self, min_pages: int = 0):
+        """Build (or return) the engine's page pool. Sizing: an explicit
+        ``kv_pool_pages`` wins; otherwise the caller's ``min_pages`` (the
+        continuous loop passes its worst-case working set). The pool is a
+        fixed allocation for the engine's lifetime — a rebuild replaces the
+        whole engine, pool included."""
+        from .paging import PagedKVPool
+
+        with self._paged_mutex:
+            if self._kv_pool is None:
+                from .paging import pages_for
+
+                # Default sizing mirrors what the DENSE prefix cache would
+                # hold: one mid-size run per entry plus one in flight. An
+                # explicit kv_pool_pages or a larger caller min_pages wins.
+                cache_pages = 0
+                if self.prefix_cache_size:
+                    cache_pages = (self.prefix_cache_size + 1) * pages_for(
+                        min(self.config.max_seq_len, 2048), self.kv_page_size
+                    )
+                total = max(
+                    int(self.kv_pool_pages or 0), int(min_pages),
+                    cache_pages, 8,
+                )
+                self._kv_pool = PagedKVPool(self.config, total, self.kv_page_size)
+                if self.mesh is not None:
+                    # Pool layout [L, flat, KVH, D]: kv heads sharded on the
+                    # tp axis, like every dense KV buffer here.
+                    self._kv_pool.kv = jax.device_put(
+                        self._kv_pool.kv,
+                        KVCache(
+                            k=NamedSharding(self.mesh, P(None, None, MODEL_AXIS, None)),
+                            v=NamedSharding(self.mesh, P(None, None, MODEL_AXIS, None)),
+                        ),
+                    )
+            return self._kv_pool
+
+    def _alloc_pages_with_evict(self, count: int) -> List[int]:
+        """Allocate pages, evicting LRU paged cache entries under pressure.
+        Caller holds ``_paged_mutex``. Raises PagePoolExhausted only when the
+        pool is short even with every evictable entry gone."""
+        from .paging import PagePoolExhausted
+
+        alloc = self._kv_pool.allocator
+        try:
+            return alloc.alloc(count)
+        except PagePoolExhausted:
+            self._evict_paged_entries(need_pages=count - alloc.free_pages)
+            return alloc.alloc(count)
+
+    def _evict_paged_entries(self, need_pages: int) -> int:
+        """Evict paged prefix-cache entries LRU-first until ``need_pages``
+        pages have actually returned to the free stack. Pages still referenced
+        by in-flight rows (or by a younger entry extending this one) survive
+        the eviction — only the entry's own reference drops, and the last
+        reader's retirement frees them (pinned by
+        test_paged_eviction.py)."""
+        from .paging import PagedPrefixRun
+
+        freed = 0
+        for key in list(self._prefix_entries.keys()):
+            if freed >= need_pages:
+                break
+            run = self._prefix_entries[key][1]
+            if isinstance(run, PagedPrefixRun):
+                del self._prefix_entries[key]
+                freed += run.release()
+        return freed
+
+    def _run_from_dense(
+        self,
+        prefix: KVCache,
+        plen: int,
+        bucket: int,
+        base_run=None,
+        base_len: int = 0,
+    ):
+        """Convert a dense prefill result [L, 1, bucket, KVH, D] into a page
+        run. When ``base_run`` is the cache entry this prefill CONTINUED from,
+        its full pages below ``base_len`` are SHARED (incref, no copy, no
+        rewrite) — the continuation seeded its cache from those exact bits, so
+        sharing preserves the bit-equality contract; only the new tail is
+        scattered. Caller holds ``_paged_mutex``."""
+        from .paging import TRASH_PAGE, PagedPrefixRun, flat_slots, pages_for
+
+        pool = self._ensure_kv_pool()
+        ps = pool.page_size
+        npages = pages_for(plen, ps)
+        shared = 0
+        if base_run is not None:
+            shared = min(min(base_len, plen) // ps, npages)
+            if shared:
+                pool.allocator.incref(base_run.pages[:shared])
+        try:
+            fresh = self._alloc_pages_with_evict(npages - shared)
+        except Exception:
+            if shared:
+                pool.allocator.decref(base_run.pages[:shared])
+            raise
+        pages = list(base_run.pages[:shared] if shared else []) + fresh
+        # Fixed-length scatter (bucket positions → few jit variants): shared
+        # pages and post-prompt positions retarget into the trash page, whose
+        # contents are don't-care by contract.
+        idx = flat_slots(pages, np.arange(bucket), ps)
+        trash = (np.arange(bucket) % ps + TRASH_PAGE * ps).astype(np.int32)
+        if shared:
+            idx[: shared * ps] = trash[: shared * ps]
+        idx[plen:] = trash[plen:]
+        pool.scatter_tokens(prefix.k[:, 0], prefix.v[:, 0], idx)
+        return PagedPrefixRun(pool, pages, plen, bucket)
+
+    def _entry_prefix_kv(self, entry) -> KVCache:
+        """Entry slot 1 as dense arrays (materializing a page run)."""
+        from .paging import PagedPrefixRun
+
+        kv = entry[1]
+        if isinstance(kv, PagedPrefixRun):
+            return kv.materialize()
+        return kv
+
+    def paged_admit_prefix(self, prompt_ids: List[int], prompt_len: int, bucket: int):
+        """Admission-time prefix for the continuous decode loop's PAGED mode:
+        returns ``(first_logits, run, transient)``. A cached paged entry's run
+        is returned directly (zero device work, pages shared); otherwise the
+        routed prefill runs and its result becomes either the just-stored
+        cache run or, with the cache disabled, a TRANSIENT run the caller
+        releases after pinning pages per row. May raise
+        :class:`~.paging.PagePoolExhausted` — the loop keeps the request
+        queued and retries after retirements free pages."""
+        from .paging import PagedPrefixRun
+
+        key = tuple(prompt_ids)
+        with self._paged_mutex:
+            if self.prefix_cache_size > 0:
+                hit = self._prefix_entries.get(key)
+                if hit is not None and isinstance(hit[1], PagedPrefixRun):
+                    self._prefix_entries.move_to_end(key)
+                    self.prefix_cache_stats["hits"] += 1
+                    return hit[0], hit[1], False
+        first_logits, prefix = self._prefill_routed(prompt_ids, prompt_len, bucket)
+        with self._paged_mutex:
+            if self.prefix_cache_size > 0:
+                hit = self._prefix_entries.get(key)
+                if hit is not None and isinstance(hit[1], PagedPrefixRun):
+                    return first_logits, hit[1], False
+            run = self._run_from_dense(prefix, prompt_len, bucket)
+            return first_logits, run, True
+
     def _prefix_store(
-        self, ids: List[int], first_logits, prefix: KVCache, seq_sharded: bool = False
+        self,
+        ids: List[int],
+        first_logits,
+        prefix: KVCache,
+        seq_sharded: bool = False,
+        base_run=None,
+        base_len: int = 0,
     ) -> None:
-        key = tuple(ids)
-        self._prefix_entries[key] = (
-            first_logits, prefix, len(ids), np.asarray(ids, np.int32), seq_sharded
-        )
-        self._prefix_entries.move_to_end(key)
-        while len(self._prefix_entries) > self.prefix_cache_size:
-            self._prefix_entries.popitem(last=False)
+        from .paging import PagedPrefixRun, PagePoolExhausted
+
+        stored = prefix
+        with self._paged_mutex:
+            if self.kv_layout == "paged" and not seq_sharded:
+                # Entries live as page runs; sibling entries extending a
+                # common prefix SHARE its full pages instead of copying
+                # (base_run). Pool pressure falls back to a dense entry —
+                # correctness never depends on pages being available.
+                try:
+                    stored = self._run_from_dense(
+                        prefix, len(ids), int(prefix.k.shape[2]),
+                        base_run=base_run, base_len=base_len,
+                    )
+                except PagePoolExhausted:
+                    stored = prefix
+            key = tuple(ids)
+            old = self._prefix_entries.get(key)
+            if old is not None and isinstance(old[1], PagedPrefixRun):
+                old[1].release()
+            self._prefix_entries[key] = (
+                first_logits, stored, len(ids), np.asarray(ids, np.int32), seq_sharded
+            )
+            self._prefix_entries.move_to_end(key)
+            while len(self._prefix_entries) > self.prefix_cache_size:
+                _, evicted = self._prefix_entries.popitem(last=False)
+                if isinstance(evicted[1], PagedPrefixRun):
+                    evicted[1].release()
 
     def _prefix_match(self, ids: List[int]) -> Tuple[Optional[KVCache], int]:
         """Longest common token prefix across cached prompts (vectorized —
@@ -762,15 +960,37 @@ class LocalEngine:
         returned when the caller declares it reshards them (generate_many's
         replicated coalesced path does); otherwise the wrong-layout hit is a
         miss — the mirror of _sp_prefill_routed's layout check."""
-        config = self.config
-        key = tuple(prompt_ids)
-        hit = self._prefix_entries.get(key)
-        if hit is not None and (allow_seq_sharded or not hit[4]):
-            self._prefix_entries.move_to_end(key)
-            self.prefix_cache_stats["hits"] += 1
-            return hit[0], hit[1]
+        from .paging import PagedPrefixRun
 
-        matched_kv, p = self._prefix_match(prompt_ids)
+        key = tuple(prompt_ids)
+        with self._paged_mutex:
+            hit = self._prefix_entries.get(key)
+            if hit is not None and (allow_seq_sharded or not hit[4]):
+                self._prefix_entries.move_to_end(key)
+                self.prefix_cache_stats["hits"] += 1
+                return hit[0], self._entry_prefix_kv(hit)
+
+            matched_kv, p = self._prefix_match(prompt_ids)
+            matched_run = matched_kv if isinstance(matched_kv, PagedPrefixRun) else None
+            if matched_run is not None:
+                # Pin the matched run's pages for the duration of this call:
+                # a concurrent store's eviction must not free them while the
+                # continuation reads them (or before the new entry increfs
+                # the shared prefix pages).
+                matched_run.retain()
+        try:
+            return self._prefill_with_cache_matched(
+                prompt_ids, prompt_len, bucket, matched_kv, matched_run, p
+            )
+        finally:
+            if matched_run is not None:
+                with self._paged_mutex:
+                    self._kv_pool.allocator.decref(matched_run.pages)
+
+    def _prefill_with_cache_matched(
+        self, prompt_ids, prompt_len, bucket, matched_kv, matched_run, p
+    ):
+        config = self.config
         s_bucket = _bucket(max(1, prompt_len - p), minimum=32)
         # Power-of-two rounding capped at max_seq_len: no position past the
         # model's maximum is ever addressable, so rows beyond it would be
@@ -789,6 +1009,7 @@ class LocalEngine:
                 <= self.MAX_CONT_SCORE_BYTES
             )
         )
+        base_run, base_len = None, 0
         if continuation_ok:
             self.prefix_cache_stats["partial_hits"] += 1
             suffix = prompt_ids[p:]
@@ -800,12 +1021,19 @@ class LocalEngine:
             # silently CLAMPS an out-of-bounds start index (which would land
             # the suffix KV at the wrong rows). The continuation jit donates
             # this buffer and writes the suffix KV in place.
-            pad = [(0, 0)] * 5
-            pad[2] = (0, cont_bucket - p)
-            cache0 = KVCache(
-                k=jnp.pad(matched_kv.k[:, :, :p], pad),
-                v=jnp.pad(matched_kv.v[:, :, :p], pad),
-            )
+            if matched_run is not None:
+                # Paged entry: gather positions [0, p) out of the pool into
+                # the dense seed (bit-identical to the pad-of-slice below at
+                # every position the continuation reads).
+                cache0 = matched_run.gather_prefix_padded(p, cont_bucket)
+                base_run, base_len = matched_run, p
+            else:
+                pad = [(0, 0)] * 5
+                pad[2] = (0, cont_bucket - p)
+                cache0 = KVCache(
+                    k=jnp.pad(matched_kv.k[:, :, :p], pad),
+                    v=jnp.pad(matched_kv.v[:, :, :p], pad),
+                )
             first_logits, prefix = self._get_prefill_continue(s_bucket, cont_bucket)(
                 self.params, suffix_tokens, cache0,
                 jnp.int32(p), jnp.int32(prompt_len),
@@ -825,6 +1053,7 @@ class LocalEngine:
         self._prefix_store(
             prompt_ids, first_logits, prefix,
             seq_sharded=self._kv_seq_sharded(prefix),
+            base_run=base_run, base_len=base_len,
         )
         return first_logits, prefix
 

@@ -865,3 +865,157 @@ def verify_step(
     h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     logits = _logits(config, params, h)
     return logits, gen_cache
+
+
+# ---------------------------------------------------------------------------
+# Paged KV path (block-table gather over a flat page pool)
+# ---------------------------------------------------------------------------
+
+def _apply_stack_paged(
+    config: ModelConfig,
+    params: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    pool_kv: KVCache,
+    prefix_idx: jax.Array,
+    gen_idx: jax.Array,
+    write_index: jax.Array,
+    key_mask: jax.Array,
+    prefix_mask: jax.Array,
+    key_mask_global: Optional[jax.Array] = None,
+    prefix_mask_global: Optional[jax.Array] = None,
+    prefix_lengths: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Paged twin of :func:`_apply_stack`: per-layer KV is GATHERED from a
+    flat page pool through block tables instead of read from dense caches.
+
+    pool_kv k/v: ``[L, total_pages * page_size, KVH, D]``; prefix_idx /
+    gen_idx: int32 ``[B, P]`` / ``[B, G]`` flat pool slots for each row's
+    prompt and generated positions (out-of-table positions map into the trash
+    page and are masked by the caller). The gather happens INSIDE the layer
+    scan, so the dense transient is one layer's worth — 1/L of a dense cache.
+
+    Per layer this calls the same :func:`_block` as the dense path on the
+    gathered arrays; since unmasked gathered values are bit-identical to the
+    dense cache contents and masked slots contribute an exact 0.0 through the
+    softmax (scores forced to ``finfo.min`` before the max; ``exp`` underflows
+    to 0; ``0 * finite == 0``), the whole stack is byte-identical to
+    :func:`_apply_stack` on equal inputs. Returns ``(x, k_cols, v_cols)``
+    where the cols, ``[L, B, KVH, D]``, are each row's freshly written KV
+    column — the caller scatters them back into the pool at each row's write
+    slot (the rest of the transient would round-trip unchanged).
+    """
+    from ..ops.attention import gather_kv_pages
+
+    local_flags = _local_layer_flags(config) if key_mask_global is not None else None
+
+    def body(carry, scanned):
+        x = carry
+        flag = scanned.get("flag")
+        if flag is None:
+            km, pm = key_mask, prefix_mask
+            window_value = config.sliding_window
+        else:
+            km = jnp.where(flag, key_mask, key_mask_global)
+            pm = jnp.where(flag, prefix_mask, prefix_mask_global)
+            from ..ops.attention import NO_WINDOW
+
+            window_value = jnp.where(
+                flag, jnp.int32(config.sliding_window), jnp.int32(NO_WINDOW)
+            )
+        pool_k_l, pool_v_l = scanned["pool"]
+        pk, pv = gather_kv_pages(pool_k_l, pool_v_l, prefix_idx)  # [B, P, KVH, D]
+        gk, gv = gather_kv_pages(pool_k_l, pool_v_l, gen_idx)  # [B, G, KVH, D]
+        x, new_kv = _block(
+            config,
+            scanned["layers"],
+            x,
+            positions,
+            (gk, gv),
+            write_index,
+            km,
+            prefix_kv=(pk, pv),
+            prefix_mask=pm,
+            prefix_lengths=prefix_lengths,
+            window_value=window_value,
+        )
+        # Keep only the column each row just wrote at its own offset; the
+        # rest of the gathered transient is pool state that didn't change.
+        idx = write_index.reshape(-1, 1, 1, 1).astype(jnp.int32)
+        k_col = jnp.take_along_axis(new_kv[0], idx, axis=1)[:, 0]
+        v_col = jnp.take_along_axis(new_kv[1], idx, axis=1)[:, 0]
+        return x, (k_col, v_col)
+
+    xs = {"layers": params["layers"], "pool": (pool_kv.k, pool_kv.v)}
+    if local_flags is not None:
+        xs["flag"] = local_flags
+    x, cols = lax.scan(body, x, xs)
+    return x, cols[0], cols[1]
+
+
+def paged_verify_step(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    prompt_len: jax.Array,
+    pool_kv: KVCache,
+    prefix_idx: jax.Array,
+    gen_idx: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Paged twin of :func:`verify_step` at ``Sq == 1`` — the continuous
+    decode loop's step when its slots hold block tables into a shared page
+    pool instead of dense per-row caches.
+
+    tokens: [B, 1] current tokens; lengths: [B] generated counts (also each
+    row's write offset into its gen slots); prompt_len: scalar or [R];
+    pool_kv: the flat page pool ``[L, flat, KVH, D]``; prefix_idx [B, P] /
+    gen_idx [B, G]: flat pool slots per logical position. Masks are built
+    EXACTLY as in :func:`verify_step` (same shapes, same predicates), so the
+    two paths select identical ``_block`` branches and produce bit-identical
+    logits — pinned by tests/test_paged_differential.py. Returns
+    (logits f32 [B, 1, V], k_cols, v_cols [L, B, KVH, D]).
+    """
+    B, Sq = tokens.shape
+    G = gen_idx.shape[1]
+    P = prefix_idx.shape[1]
+
+    pl = jnp.asarray(prompt_len, jnp.int32).reshape(-1)
+    pl_row = jnp.repeat(pl, B // pl.shape[0], total_repeat_length=B)  # [B]
+    lengths = lengths.astype(jnp.int32)
+
+    j = jnp.arange(Sq)[None, :]
+    positions = pl_row[:, None] + lengths[:, None] + j  # [B, Sq]
+    x = _embed(config, params, tokens)
+
+    s = jnp.arange(G)[None, None, :]
+    self_mask = s <= (lengths[:, None] + j)[:, :, None]  # [B, Sq, G]
+    c = jnp.arange(P)[None, None, :]
+    prefix_mask = (c < pl_row[:, None, None]) & jnp.ones((B, Sq, 1), bool)
+    self_mask_global = prefix_mask_global = None
+    if config.sliding_window is not None:
+        W = config.sliding_window
+        if config.sliding_window_layers == "alternating":
+            self_mask_global, prefix_mask_global = self_mask, prefix_mask
+        qpos_gen = (lengths[:, None] + j)[:, :, None]
+        self_mask = self_mask & (s > qpos_gen - W)
+        prefix_mask = prefix_mask & (c > positions[:, :, None] - W)
+
+    x, k_cols, v_cols = _apply_stack_paged(
+        config,
+        params,
+        x,
+        positions,
+        pool_kv,
+        prefix_idx,
+        gen_idx,
+        lengths,
+        self_mask,
+        prefix_mask,
+        key_mask_global=self_mask_global,
+        prefix_mask_global=prefix_mask_global,
+        prefix_lengths=pl,
+    )
+    h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
+    logits = _logits(config, params, h)
+    return logits, k_cols, v_cols

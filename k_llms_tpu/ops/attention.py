@@ -372,3 +372,24 @@ def flash_attention(
     )(key_lengths, window_arr, qoff_arr, q, k, v)
 
     return out[:, :, :Sq, :]
+
+
+def gather_kv_pages(
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    slot_idx: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Block-table gather: materialize logical KV rows from a flat page pool.
+
+    pool_k/pool_v: one layer's pool, ``[total_pages * page_size, KVH, D]``;
+    slot_idx: int32 flat slot indices of any shape (typically ``[B, S]`` —
+    each row's block table expanded to per-position slots). Returns
+    ``(k, v)`` shaped ``slot_idx.shape + (KVH, D)``.
+
+    Out-of-table positions point into the trash page (page 0) by convention;
+    their values are arbitrary-but-finite and every consumer masks their
+    scores to ``NEG_INF`` before the softmax max, so they contribute an exact
+    0.0 to the output — which is what keeps the paged attention path
+    byte-identical to the dense one.
+    """
+    return jnp.take(pool_k, slot_idx, axis=0), jnp.take(pool_v, slot_idx, axis=0)

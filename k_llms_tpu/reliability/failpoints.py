@@ -32,6 +32,12 @@ Injection sites wired in this package:
 - ``replica.probe``      — evaluated (keyed by replica id) at the top of a
                            replica health probe; ``fail`` keeps a pulled
                            member out of rotation until the spec exhausts
+- ``engine.pages``       — evaluated when the continuous decode loop releases
+                           a retired slot's KV pages; the ``leak`` action
+                           drops ``kill`` pages from the pool's free stack
+                           without accounting, so the page-conservation
+                           invariant (``ContinuousDecodeLoop.stats``) must
+                           fail fast instead of serving from a corrupt pool
 - ``serving.request``    — evaluated by the HTTP front door at request entry
                            (``serving/app.py``); the ``disconnect`` action
                            makes the server treat the client as having dropped
@@ -65,6 +71,9 @@ Actions (``FailSpec.action``):
 - ``"disconnect"``   — no-op at the site itself; the serving layer reads the
                        spec and simulates the client dropping the connection
                        mid-stream (cancel budget, abort the SSE response)
+- ``"leak"``         — no-op at the site itself; the paged-KV release path
+                       reads ``kill`` and drops that many pages from the free
+                       stack unaccounted (a simulated lost decref)
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -77,9 +86,11 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="loader.params=corrupt:1"
     KLLMS_FAILPOINTS="replica.dispatch=down:r1:2,replica.probe=fail:r1:1"
     KLLMS_FAILPOINTS="serving.request=disconnect:1"
+    KLLMS_FAILPOINTS="engine.pages=leak:2"
 where the first numeric arg is ``times`` for raise/sleep/oom/corrupt/disconnect
-specs, ``times[:delay]`` for hang, ``kill[:seed]`` for kill_samples/nan, and
-``member[:times]`` for down/fail (replica sites are keyed by replica id).
+specs, ``times[:delay]`` for hang, ``kill[:seed]`` for kill_samples/nan,
+``kill`` (pages to drop) for leak, and ``member[:times]`` for down/fail
+(replica sites are keyed by replica id).
 """
 
 from __future__ import annotations
@@ -100,6 +111,7 @@ SITES = (
     "engine.launch",
     "engine.decode",
     "engine.logits",
+    "engine.pages",
     "loader.params",
     "backend.dispatch",
     "consensus.consolidate",
@@ -151,6 +163,7 @@ class FailSpec:
             "down",
             "fail",
             "disconnect",
+            "leak",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -269,6 +282,9 @@ def configure_from_env(env: Optional[str] = None) -> None:
             kill = int(args[0]) if args else 1
             seed = int(args[1]) if len(args) > 1 else 0
             specs[site] = FailSpec(action=action, kill=kill, seed=seed)
+        elif action == "leak":
+            kill = int(args[0]) if args else 1
+            specs[site] = FailSpec(action="leak", kill=kill)
         elif action == "sleep":
             delay = float(args[0]) if args else 0.1
             times = int(args[1]) if len(args) > 1 else None

@@ -151,7 +151,21 @@ class ServingApp:
         cont = getattr(backend, "_continuous", None)
         if cont is not None:
             for key, val in sorted(cont.stats.items()):
+                if isinstance(val, dict):
+                    continue  # nested sections (page pool) export below
                 lines.append(f"kllms_continuous_{key} {val}")
+        # HBM + paged-KV pool gauges from the backend's health snapshot (the
+        # read doubles as a page-accounting invariant check).
+        if backend is not None and hasattr(backend, "health"):
+            hbm = backend.health().get("hbm") or {}
+            for key, val in sorted(hbm.items()):
+                if key == "page_pool" and isinstance(val, dict):
+                    for pk, pv in sorted(val.items()):
+                        lines.append(f"kllms_hbm_page_pool_{pk} {pv}")
+                elif isinstance(val, bool):
+                    lines.append(f"kllms_hbm_{key} {int(val)}")
+                elif isinstance(val, (int, float)) and val is not None:
+                    lines.append(f"kllms_hbm_{key} {val}")
         body = ("\n".join(lines) + "\n").encode()
         _obs.SERVE_EVENTS.record("request.metrics.200")
         await _send_bytes(send, 200, body, content_type=b"text/plain; version=0.0.4")

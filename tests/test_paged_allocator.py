@@ -1,0 +1,193 @@
+"""Page-pool accounting: the pure-Python property sweep and the fail-fast
+invariant wiring.
+
+The property test drives PageAllocator through seeded random admit / fork /
+copy-on-write / retire schedules against an independent reference allocator
+(a dozen lines of dict-and-list bookkeeping) and checks BLOCK-TABLE
+equivalence — not just counters — after every operation; both sides are
+deterministic (LIFO free stack, in-order frees), so any divergence is a real
+accounting bug, not test noise. The failpoint tests pin that a simulated lost
+decref (``engine.pages=leak:N``) trips :meth:`PageAllocator.verify` through
+the continuous loop's ``stats`` property — the serving health read IS the
+leak detector. CPU-only, no device work except the tiny leak-loop test.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from k_llms_tpu.engine.paging import (
+    TRASH_PAGE,
+    PageAccountingError,
+    PageAllocator,
+    PagePoolExhausted,
+    flat_slots,
+    pages_for,
+)
+from k_llms_tpu.reliability.failpoints import FailSpec, failpoints
+
+
+class _RefAllocator:
+    """Independent reference: same contract, naive bookkeeping."""
+
+    def __init__(self, total):
+        self.total = total
+        self.free = list(range(total - 1, 0, -1))
+        self.ref = {}
+
+    def alloc(self, count):
+        if len(self.free) < count:
+            raise MemoryError
+        pages = [self.free.pop() for _ in range(count)]
+        for p in pages:
+            self.ref[p] = 1
+        return pages
+
+    def incref(self, pages):
+        for p in pages:
+            self.ref[p] += 1
+
+    def decref(self, pages):
+        for p in pages:
+            self.ref[p] -= 1
+            if self.ref[p] == 0:
+                del self.ref[p]
+                self.free.append(p)
+
+
+def _check_equivalent(alloc, ref, rows_real, rows_ref):
+    alloc.verify()
+    assert alloc.free_pages == len(ref.free)
+    ref_arr = np.zeros(alloc.total_pages, np.int64)
+    ref_arr[TRASH_PAGE] = 1
+    for p, c in ref.ref.items():
+        ref_arr[p] = c
+    np.testing.assert_array_equal(alloc._ref, ref_arr)
+    assert rows_real == rows_ref  # block tables match page for page
+    # flat_slots agrees with a hand computation for every live table.
+    for table in rows_real:
+        pos = np.arange(len(table) * alloc.page_size + 3)
+        got = flat_slots(table, pos, alloc.page_size)
+        for i in range(len(table) * alloc.page_size):
+            assert got[i] == table[i // alloc.page_size] * alloc.page_size + (
+                i % alloc.page_size
+            )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_allocator_matches_reference_under_random_schedule(seed):
+    rng = random.Random(seed)
+    ps = 4
+    alloc = PageAllocator(48, ps)
+    ref = _RefAllocator(48)
+    rows_real, rows_ref = [], []  # parallel lists of block tables
+
+    for _ in range(600):
+        op = rng.random()
+        if op < 0.40:  # admit: a fresh shared prompt run + one private page
+            plen = rng.randint(1, 20)
+            npages = pages_for(plen, ps)
+            try:
+                shared = alloc.alloc(npages)
+            except PagePoolExhausted:
+                with pytest.raises(MemoryError):
+                    ref.alloc(npages)
+                continue
+            rows_real.append(list(shared))
+            rows_ref.append(list(ref.alloc(npages)))
+        elif op < 0.65 and rows_real:  # fork: new reader of an existing table
+            i = rng.randrange(len(rows_real))
+            alloc.incref(rows_real[i])
+            ref.incref(rows_ref[i])
+            rows_real.append(list(rows_real[i]))
+            rows_ref.append(list(rows_ref[i]))
+        elif op < 0.80 and rows_real:  # CoW: replace one shared page
+            i = rng.randrange(len(rows_real))
+            j = rng.randrange(len(rows_real[i]))
+            if alloc.refcount(rows_real[i][j]) > 1:
+                try:
+                    new = alloc.alloc(1)[0]
+                except PagePoolExhausted:
+                    continue
+                new_ref = ref.alloc(1)[0]
+                alloc.decref([rows_real[i][j]])
+                ref.decref([rows_ref[i][j]])
+                rows_real[i][j] = new
+                rows_ref[i][j] = new_ref
+                alloc.note_cow()
+        elif rows_real:  # retire
+            i = rng.randrange(len(rows_real))
+            alloc.decref(rows_real.pop(i))
+            ref.decref(rows_ref.pop(i))
+        _check_equivalent(alloc, ref, rows_real, rows_ref)
+
+    while rows_real:  # drain: everything must come back
+        alloc.decref(rows_real.pop())
+        ref.decref(rows_ref.pop())
+    _check_equivalent(alloc, ref, rows_real, rows_ref)
+    assert alloc.free_pages == alloc.total_pages - 1
+    assert alloc.snapshot()["in_use"] == 0
+
+
+def test_misuse_raises_accounting_errors():
+    alloc = PageAllocator(8, 4)
+    pages = alloc.alloc(2)
+    with pytest.raises(PageAccountingError):
+        alloc.incref([TRASH_PAGE])
+    with pytest.raises(PageAccountingError):
+        alloc.decref([5])  # never allocated
+    alloc.decref(pages)
+    with pytest.raises(PageAccountingError):
+        alloc.decref(pages)  # double free
+    with pytest.raises(PagePoolExhausted):
+        alloc.alloc(99)
+
+
+def test_leak_detection_via_verify():
+    alloc = PageAllocator(8, 4)
+    alloc.verify()
+    alloc.leak(2)
+    with pytest.raises(PageAccountingError, match="leak"):
+        alloc.verify()
+
+
+def test_leak_failpoint_trips_loop_stats():
+    """``engine.pages=leak:N`` fires on slot retirement in the continuous
+    loop; the next ``stats`` read (what backend ``health()`` polls) must
+    raise PageAccountingError rather than keep serving from a corrupt pool.
+    Uses a private engine: the poisoned pool must not leak into the shared
+    fixtures."""
+    from k_llms_tpu.engine.continuous import ContinuousDecodeLoop
+    from k_llms_tpu.engine.engine import LocalEngine
+    from k_llms_tpu.models import get_config
+
+    from conftest import shared_params
+
+    cfg = get_config("tiny")
+    eng = LocalEngine(
+        cfg, params=shared_params(cfg, 0), use_mesh=False,
+        kv_layout="paged", kv_page_size=8,
+    )
+    loop = ContinuousDecodeLoop(eng, width=2, max_prompt=32, max_new=8)
+    try:
+        with failpoints({"engine.pages": FailSpec(action="leak", kill=2, times=1)}):
+            loop.submit(
+                [3, 1, 4, 1, 5], n=1, max_new=4, temperature=0.0, top_p=None,
+                seed=2,
+            ).result(timeout=120)
+        with pytest.raises(PageAccountingError, match="leak"):
+            loop.stats
+    finally:
+        loop.stop()
+
+
+def test_leak_env_syntax_parses():
+    from k_llms_tpu.reliability import failpoints as fp
+
+    fp.configure_from_env("engine.pages=leak:3")
+    try:
+        spec = fp._registry["engine.pages"]
+        assert spec.action == "leak" and spec.kill == 3
+    finally:
+        fp.clear()

@@ -1,0 +1,212 @@
+"""Paged KV cache vs dense: the greedy differential.
+
+The paged layout (engine/paging.py + the paged twins in models/llama.py) is
+an OPTIMIZATION, not a semantic change — every test here pins byte-identical
+tokens and logprobs between a paged engine/loop and its dense twin on equal
+inputs: batch-path prefix-cache continuations, the continuous loop's steady
+decode, a request that JOINS mid-flight, and the post-abort survivors. The
+page machinery (n-way prompt sharing, copy-on-write at the first divergent
+token, reserve-at-admission) must be invisible in the outputs.
+"""
+
+import numpy as np
+import pytest
+
+from k_llms_tpu.engine.continuous import ContinuousDecodeLoop
+from k_llms_tpu.engine.engine import LocalEngine
+from k_llms_tpu.models import get_config
+from k_llms_tpu.reliability.deadline import RequestBudget
+from k_llms_tpu.types.wire import RequestCancelledError
+
+PAGE = 8  # small pages so tiny prompts still span/split several
+
+
+@pytest.fixture(scope="module")
+def engines():
+    from conftest import shared_engine
+
+    dense = shared_engine(model="tiny")
+    paged = shared_engine(model="tiny", kv_layout="paged", kv_page_size=PAGE)
+    return dense, paged
+
+
+@pytest.fixture(scope="module")
+def loops(engines):
+    dense_eng, paged_eng = engines
+    kw = dict(width=4, max_prompt=64, max_new=16)
+    dense = ContinuousDecodeLoop(dense_eng, **kw)
+    paged = ContinuousDecodeLoop(paged_eng, **kw)
+    assert not dense.paged and paged.paged
+    yield dense, paged
+    dense.stop()
+    paged.stop()
+
+
+def _both(loops, prompt, **kw):
+    dense, paged = loops
+    fd = dense.submit(prompt, **kw)
+    fp = paged.submit(prompt, **kw)
+    return fd.result(timeout=180), fp.result(timeout=180)
+
+
+def _assert_identical(rd, rp):
+    np.testing.assert_array_equal(rd.tokens, rp.tokens)
+    np.testing.assert_array_equal(rd.logprobs, rp.logprobs)
+    np.testing.assert_array_equal(rd.lengths, rp.lengths)
+    assert rd.finish_reasons == rp.finish_reasons
+
+
+def test_greedy_partial_page_fanout(loops):
+    """n=3 fan-out from a prompt that ends MID-page: all three rows' first
+    generated token lands in the shared partial page, forcing copy-on-write —
+    and the outputs must still match dense bit for bit."""
+    rd, rp = _both(
+        loops, [5, 6, 7, 8, 9, 10, 11],  # 7 tokens: page 0 is partial
+        n=3, max_new=12, temperature=0.0, top_p=None, seed=17,
+    )
+    _assert_identical(rd, rp)
+    pool = loops[1]._pool
+    assert pool.allocator.stats["cow_copies"] >= 2  # n-1 rows must copy
+
+
+def test_greedy_page_boundary_fanout(loops):
+    """Prompt length an exact page multiple: no partial page, first writes go
+    to fresh extension pages (the no-CoW branch)."""
+    rd, rp = _both(
+        loops, list(range(5, 5 + 2 * PAGE)),  # exactly 2 pages
+        n=2, max_new=10, temperature=0.0, top_p=None, seed=23,
+    )
+    _assert_identical(rd, rp)
+
+
+def test_sampled_identical(loops):
+    """Sampling keys derive from (seed, step, sample_idx) only, so the paged
+    loop must reproduce the dense loop's sampled stream exactly."""
+    rd, rp = _both(
+        loops, [1, 2, 3, 4], n=2, max_new=10,
+        temperature=0.8, top_p=0.9, seed=3,
+    )
+    _assert_identical(rd, rp)
+
+
+def test_midflight_join_identical(loops):
+    """A request joining a decode already in flight must come out identical
+    on both layouts (and the paged join must not disturb the first request's
+    pages — its rows keep decoding through the same block tables)."""
+    results = {}
+    for name, loop in zip(("dense", "paged"), loops):
+        holder = {}
+
+        def sink(step, _toks, loop=loop, holder=holder):
+            if step == 0 and "b" not in holder:
+                holder["b"] = loop.submit(
+                    [4, 5, 6], n=2, max_new=6, temperature=0.7, top_p=0.95,
+                    seed=12,
+                )
+
+        a = loop.submit(
+            [1, 2, 3], n=2, max_new=14, temperature=0.7, top_p=0.95, seed=11,
+            token_sink=sink,
+        ).result(timeout=180)
+        b = holder["b"].result(timeout=180)
+        results[name] = (a, b)
+        assert loop.stats["joined_in_flight"] >= 1
+    _assert_identical(results["dense"][0], results["paged"][0])
+    _assert_identical(results["dense"][1], results["paged"][1])
+
+
+def test_budget_abort_releases_pages_and_survivors_match(loops):
+    """Cancel a paged request mid-flight: its rows' pages must return to the
+    pool (conservation checked by the stats property), and a follow-up
+    request decodes identically to dense."""
+    dense, paged = loops
+    budget = RequestBudget()
+    fut = paged.submit(
+        [9, 8, 7, 6, 5], n=2, max_new=16, temperature=0.9, top_p=0.9, seed=5,
+        budget=budget,
+    )
+    import time
+
+    time.sleep(0.02)
+    budget.cancel()
+    with pytest.raises(RequestCancelledError):
+        fut.result(timeout=180)
+    rd, rp = _both(
+        loops, [2, 4, 6, 8], n=2, max_new=8, temperature=0.0, top_p=None,
+        seed=9,
+    )
+    _assert_identical(rd, rp)
+
+
+def test_drain_leaves_zero_loop_refs(loops):
+    """After quiescing, the loop holds no page references and the pool's
+    accounting invariants verify clean (stats runs PageAllocator.verify)."""
+    dense, paged = loops
+    assert paged.drain(timeout=60)
+    s = paged.stats
+    assert s["pages"]["loop_refs"] == 0
+    # The module's engines run without a prefix cache, so nothing else may
+    # hold pages either: every page is back on the free stack.
+    assert s["pages"]["in_use"] == 0
+    paged._closing = False  # reopen for any later tests in this module
+
+
+# -- batch path: prefix-cache entries as page runs --------------------------
+
+
+@pytest.fixture(scope="module")
+def cached_engines():
+    from conftest import shared_params
+
+    cfg = get_config("tiny")
+    params = shared_params(cfg, 0)
+    plain = LocalEngine(cfg, params=params, use_mesh=False)
+    kw = dict(prefix_cache_size=4, prefix_cache_min_reuse=16)
+    dense = LocalEngine(cfg, params=params, use_mesh=False, **kw)
+    paged = LocalEngine(
+        cfg, params=params, use_mesh=False,
+        kv_layout="paged", kv_page_size=PAGE, **kw,
+    )
+    return plain, dense, paged
+
+
+SYSTEM = [(i * 37) % 200 + 5 for i in range(40)]
+DOC_A = [(i * 11) % 190 + 7 for i in range(20)]
+DOC_B = [(i * 13) % 180 + 9 for i in range(25)]
+
+
+def test_batch_exact_hit_serves_from_pages(cached_engines):
+    plain, dense, paged = cached_engines
+    prompt = SYSTEM + DOC_A
+    kw = dict(n=2, max_new_tokens=4, temperature=0.7, seed=5)
+    r1 = paged.generate(prompt, **kw)
+    assert paged.prefix_cache_stats["misses"] == 1
+    r2 = paged.generate(prompt, **kw)  # exact hit: materialized from pages
+    assert paged.prefix_cache_stats["hits"] == 1
+    np.testing.assert_array_equal(r1.tokens, r2.tokens)
+    ref = plain.generate(prompt, **kw)
+    np.testing.assert_array_equal(r1.tokens, ref.tokens)
+    # The entry holds real pool pages.
+    assert paged._kv_pool is not None
+    assert paged._kv_pool.allocator.in_use_pages > 0
+
+
+def test_batch_continuation_shares_prefix_pages(cached_engines):
+    """Second document extends the cached system prefix: the paged entry for
+    SYSTEM+DOC_B must SHARE the matched run's full pages (refcount > 1, no
+    copy) and still generate byte-identically to dense and uncached."""
+    plain, dense, paged = cached_engines
+    kw1 = dict(n=2, max_new_tokens=4, temperature=0.7, seed=7)
+    kw2 = dict(n=2, max_new_tokens=4, temperature=0.7, seed=8)
+    for eng in (dense, paged):
+        eng.generate(SYSTEM + DOC_A, **kw1)
+    r_paged = paged.generate(SYSTEM + DOC_B, **kw2)
+    assert paged.prefix_cache_stats["partial_hits"] >= 1
+    r_dense = dense.generate(SYSTEM + DOC_B, **kw2)
+    r_plain = plain.generate(SYSTEM + DOC_B, **kw2)
+    np.testing.assert_array_equal(r_paged.tokens, r_dense.tokens)
+    np.testing.assert_array_equal(r_paged.tokens, r_plain.tokens)
+    np.testing.assert_array_equal(r_paged.logprobs, r_dense.logprobs)
+    # Shared full pages of the common prefix: at least one page is held by
+    # both entries.
+    assert paged._kv_pool.allocator.shared_pages > 0

@@ -1,0 +1,111 @@
+"""Prefix-cache eviction vs in-flight readers of shared pages.
+
+A paged cache entry owns its pages through one refcount; every in-flight
+reader (a continuous-loop row mid-decode, a continuation prefill pinning its
+matched run) holds its own. Evicting the entry — LRU pressure or explicit —
+may therefore only drop the ENTRY's reference: the pages must survive, still
+serving bit-exact gathers, until the last reader retires, and only then
+return to the free stack.
+"""
+
+import numpy as np
+import pytest
+
+from k_llms_tpu.engine.continuous import ContinuousDecodeLoop
+from k_llms_tpu.engine.engine import LocalEngine
+from k_llms_tpu.models import get_config
+
+PAGE = 8
+
+
+@pytest.fixture()
+def paged_engine():
+    from conftest import shared_params
+
+    cfg = get_config("tiny")
+    return LocalEngine(
+        cfg, params=shared_params(cfg, 0), use_mesh=False,
+        kv_layout="paged", kv_page_size=PAGE,
+        prefix_cache_size=2, prefix_cache_min_reuse=8,
+    )
+
+
+def test_evicted_entry_pages_survive_until_reader_retires(paged_engine):
+    """Pin an entry's run like an in-flight reader, evict everything, and
+    check the pages stay owned (and readable, bit-exact) until the pin
+    drops."""
+    eng = paged_engine
+    prompt = [(i * 31) % 150 + 3 for i in range(20)]
+    eng.generate(prompt, n=1, max_new_tokens=2, temperature=0.0, seed=1)
+    alloc = eng._kv_pool.allocator
+    with eng._paged_mutex:
+        (entry,) = eng._prefix_entries.values()
+        run = entry[1]
+        pages = list(run.pages)
+        before = run.materialize()
+        run.retain()  # the in-flight reader's pin
+    try:
+        with eng._paged_mutex:
+            eng._evict_paged_entries(10**9)  # evict ALL entries
+        assert not eng._prefix_entries
+        # Entry's reference dropped, reader's survives: still owned...
+        assert all(alloc.refcount(p) == 1 for p in pages)
+        # ...and gathers still return the exact prefill bytes.
+        after = run.materialize()
+        np.testing.assert_array_equal(
+            np.asarray(before.k), np.asarray(after.k)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(before.v), np.asarray(after.v)
+        )
+    finally:
+        alloc.decref(pages)  # reader retires — NOW the pages free
+    assert all(alloc.refcount(p) == 0 for p in pages)
+    alloc.verify()
+    assert alloc.snapshot()["in_use"] == 0
+
+
+def test_loop_rows_survive_lru_eviction_midflight(paged_engine):
+    """End to end: rows decode from a cached run while cache-churning batch
+    requests evict that entry mid-flight. The rows' gathers must stay bound
+    to live pages (refcounted by the rows), and the final tokens must equal a
+    dense engine's."""
+    from conftest import shared_engine, shared_params
+
+    eng = paged_engine
+    loop = ContinuousDecodeLoop(eng, width=2, max_prompt=64, max_new=24)
+    prompt = [(i * 17) % 140 + 5 for i in range(12)]
+    churn = [
+        [(i * 19) % 130 + 6 for i in range(16)],
+        [(i * 23) % 120 + 7 for i in range(18)],
+    ]
+    evicted = {"done": False}
+
+    def sink(step, _toks):
+        if step == 1 and not evicted["done"]:
+            evicted["done"] = True
+            # prefix_cache_size=2: two distinct stores evict the loop
+            # request's entry while its rows are still decoding from it.
+            for c in churn:
+                eng.generate(c, n=1, max_new_tokens=2, temperature=0.0, seed=3)
+
+    try:
+        got = loop.submit(
+            prompt, n=2, max_new=16, temperature=0.0, top_p=None, seed=4,
+            token_sink=sink,
+        ).result(timeout=180)
+        assert evicted["done"]
+        dense = shared_engine(model="tiny")
+        dense_loop = ContinuousDecodeLoop(dense, width=2, max_prompt=64, max_new=24)
+        try:
+            want = dense_loop.submit(
+                prompt, n=2, max_new=16, temperature=0.0, top_p=None, seed=4,
+            ).result(timeout=180)
+        finally:
+            dense_loop.stop()
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        np.testing.assert_array_equal(got.logprobs, want.logprobs)
+        assert loop.drain(timeout=60)
+        assert loop.stats["pages"]["loop_refs"] == 0
+    finally:
+        loop.stop()
