@@ -558,6 +558,72 @@ def bench_host_consensus() -> dict:
     }
 
 
+def bench_consensus() -> dict:
+    """Host vs device consolidation across n ∈ {8, 32, 128} (hermetic; on CI
+    the "device" is CPU-JAX, same kernels as chip). Axes per n: cold (fresh
+    scorer per request, empty caches) vs warm (shared scorer, production
+    config), and device with the bucket/memo caches disabled — the cache's
+    own contribution. Headline: warm device n=32 vs the r05 host baseline
+    (15.74 ms), the ISSUE r08 3x target."""
+    from k_llms_tpu.consensus.consolidation import consolidate_chat_completions
+    from k_llms_tpu.consensus.device import DeviceSimilarityScorer, device_available
+    from k_llms_tpu.consensus.similarity import SimilarityScorer
+    from k_llms_tpu.types import ChatCompletion
+    from k_llms_tpu.utils.quality import DEFAULT_TRUTH, make_noisy_samples
+
+    def make_comp(n: int) -> ChatCompletion:
+        samples = make_noisy_samples(DEFAULT_TRUTH, n, 0.15, 7)
+        return ChatCompletion.model_validate(
+            {
+                "id": "c", "created": 0, "model": "m", "object": "chat.completion",
+                "choices": [
+                    {
+                        "finish_reason": "stop",
+                        "index": i,
+                        "message": {"role": "assistant", "content": s},
+                    }
+                    for i, s in enumerate(samples)
+                ],
+            }
+        )
+
+    def timed(comp, factory, reps: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            consolidate_chat_completions(comp, factory())
+        return round((time.perf_counter() - t0) / reps * 1000.0, 2)
+
+    def fresh_device(cache: bool):
+        s = DeviceSimilarityScorer(method="levenshtein")
+        s.cache_enabled = cache
+        return s
+
+    out: dict = {"device_available": device_available(), "grid": []}
+    for n in (8, 32, 128):
+        comp = make_comp(n)
+        reps = 15 if n <= 32 else 5
+        host_shared = SimilarityScorer.levenshtein()
+        consolidate_chat_completions(comp, host_shared)  # warm the shared scorer
+        row: dict = {
+            "n": n,
+            "host_cold_ms": timed(comp, SimilarityScorer.levenshtein, reps),
+            "host_warm_ms": timed(comp, lambda: host_shared, reps),
+        }
+        if out["device_available"]:
+            dev_shared = DeviceSimilarityScorer(method="levenshtein")
+            consolidate_chat_completions(comp, dev_shared)  # jit + cache warm
+            row["device_cold_ms"] = timed(comp, lambda: fresh_device(True), reps)
+            row["device_nocache_ms"] = timed(comp, lambda: fresh_device(False), reps)
+            row["device_warm_ms"] = timed(comp, lambda: dev_shared, reps)
+            row["speedup_warm_x"] = round(row["host_warm_ms"] / row["device_warm_ms"], 2)
+        out["grid"].append(row)
+    r05_host_warm_n32 = 15.74  # BENCH_r05 detail.host_consensus.warm_ms
+    for row in out["grid"]:
+        if row["n"] == 32 and "device_warm_ms" in row:
+            out["speedup_vs_r05_host_x"] = round(r05_host_warm_n32 / row["device_warm_ms"], 2)
+    return out
+
+
 def bench_serving() -> dict:
     """Hermetic serving workload (PR 6): a loopback HTTP server (stdlib
     runner, ServerThread) over the tiny CPU backend, driven with httpx —
@@ -810,6 +876,10 @@ def main() -> None:
         detail["host_consensus"] = bench_host_consensus()
     except Exception as exc:
         detail["host_consensus"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        detail["consensus"] = bench_consensus()
+    except Exception as exc:  # hermetic like quality; a failure here is a bug
+        detail["consensus"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     try:
         detail["paged_kv"] = bench_paged_kv()
     except Exception as exc:  # hermetic like quality; a failure here is a bug

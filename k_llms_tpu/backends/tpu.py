@@ -180,6 +180,12 @@ class BackendConfig(BaseModel):
     # Total pool pages. None = sized by the continuous loop from its own
     # width/prompt/new bounds (worst-case no-sharing occupancy plus slack).
     kv_pool_pages: Optional[int] = None
+    # -- on-device consensus (PR 8) ---------------------------------------
+    # Route consolidation's pairwise-similarity and majority-vote kernels
+    # through batched JAX on the chip (consensus/device.py), with automatic
+    # per-consolidation host fallback (failpoint, busy chip, unsupported
+    # payload shape, JAX unavailable). False = always the host Python path.
+    device_consensus: bool = True
 
 
 def _detect_hbm_bytes() -> Optional[int]:
@@ -446,6 +452,8 @@ class TpuBackend(Backend):
             max_queue_weight=cfg.max_queue_weight,
             **scheduler_kwargs,
         )
+        # Consensus cache/dispatch stats ride along scheduler.stats()/health().
+        self.scheduler.consensus_stats_provider = self._consensus_stats
         # Self-healing supervision: every device launch runs under the
         # watchdog; a hung or poison-escalated engine is rebuilt through
         # _rebuild_engine and the launch replayed on the new engine. The
@@ -1064,7 +1072,59 @@ class TpuBackend(Backend):
             hbm["page_pool"] = pool.allocator.snapshot()
             hbm["page_pool_bytes"] = pool.pool_bytes()
         snap["hbm"] = hbm
+        snap["consensus"] = self._consensus_stats()
         return snap
+
+    # -- on-device consensus ----------------------------------------------
+    def similarity_scorer(self, method: str):
+        """Per-method scorer registry, like the base, but constructing the
+        device-kernel scorer when ``device_consensus`` is on. Falls back to
+        the plain host scorer at construction time when JAX/devices are
+        unavailable (run-time fallback is per-consolidation, inside the
+        device scorer itself)."""
+        if not self.backend_config.device_consensus:
+            return super().similarity_scorer(method)
+        from ..consensus.device import DeviceConsensusUnavailable, DeviceSimilarityScorer
+        from ..consensus.similarity import SimilarityScorer
+        from ..utils.observability import CONSENSUS_EVENTS
+
+        with Backend._scorer_registry_lock:
+            registry = self.__dict__.setdefault("_similarity_scorers", {})
+            scorer = registry.get(method)
+            if scorer is None:
+                try:
+                    scorer = DeviceSimilarityScorer(method=method, embed_fn=self.embeddings)
+                except DeviceConsensusUnavailable:
+                    CONSENSUS_EVENTS.record("consensus.fallback_unavailable")
+                    scorer = SimilarityScorer(method=method, embed_fn=self.embeddings)
+                registry[method] = scorer
+            return scorer
+
+    def _consensus_stats(self) -> Dict[str, Any]:
+        """Cache totals + per-scorer breakdown + dispatch counters, surfaced
+        in scheduler stats/health and as kllms_consensus_* gauges."""
+        from ..utils.observability import CONSENSUS_EVENTS
+
+        agg = {"hits": 0, "misses": 0, "entries": 0, "evictions": 0}
+        caches: Dict[str, Any] = {}
+        with Backend._scorer_registry_lock:
+            scorers = dict(self.__dict__.get("_similarity_scorers") or {})
+        for method, scorer in scorers.items():
+            stats = scorer.cache_stats()
+            caches[method] = stats
+            for s in stats.values():
+                for k in agg:
+                    agg[k] += s.get(k, 0)
+        return {
+            "device_consensus": bool(self.backend_config.device_consensus),
+            "cache": agg,
+            "caches": caches,
+            "events": {
+                k: v
+                for k, v in CONSENSUS_EVENTS.snapshot().items()
+                if k.startswith("consensus.")
+            },
+        }
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown: close admission (new requests get a typed 503),

@@ -14,6 +14,7 @@ scanning. The Hungarian solve goes through our native C++
 
 from __future__ import annotations
 
+import functools
 import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -22,6 +23,7 @@ import numpy as np
 
 from ..native import linear_sum_assignment
 from .majority import _original_positions, sort_by_original_majority
+from .similarity import freeze_key
 
 logger = logging.getLogger(__name__)
 
@@ -250,12 +252,19 @@ def _index_medoid(indices: List[Index]) -> Index:
     medoid is the argmax of nan-diagonal row means — np.argmax's first-hit
     tie rule matching `_medoid_consensus` exactly.
     """
+    return indices[_index_medoid_pos(tuple(indices))]
+
+
+@functools.lru_cache(maxsize=65536)
+def _index_medoid_pos(indices: tuple) -> int:
+    """Memoized core of :func:`_index_medoid` — pure in the index tuple, and
+    the same member sets recur across refinement rounds and warm requests."""
     arr = np.asarray(indices, dtype=np.float64)  # [M, 2]
     a, b = arr[:, None, :], arr[None, :, :]
     close = np.abs(a - b) <= 0.01 * np.maximum(np.abs(a), np.abs(b))
     sim = np.where(close, 1.0, 1e-8).mean(axis=-1)
     np.fill_diagonal(sim, np.nan)
-    return indices[int(np.argmax(np.nanmean(sim, axis=1)))]
+    return int(np.argmax(np.nanmean(sim, axis=1)))
 
 
 def _refinement_pass(
@@ -423,16 +432,40 @@ def lists_alignment(
     if not any(list_of_lists):
         return [[] for _ in list_of_lists], [[None] * len(lst) for lst in list_of_lists]
 
+    # Whole-alignment memo: the index table alone determines the output
+    # (aligned cells are always the caller's own objects — _original_positions
+    # matches by id()), so a hit replays the assignment against the current
+    # call's lists and never leaks stale objects across consolidations.
+    cache = getattr(getattr(sim_fn, "__self__", None), "_align_cache", None)
+    key = None
+    if cache is not None:
+        frozen = freeze_key(list_of_lists, budget=4096)
+        if frozen is not None:
+            key = (
+                frozen, min_support_ratio, max_novelty_ratio,
+                reference_list_idx, refinement_rounds,
+            )
+            sources = cache.get(key)
+            if sources is not None:
+                aligned = [
+                    [None if s is None else lst[s] for s in srcs]
+                    for lst, srcs in zip(list_of_lists, sources)
+                ]
+                return aligned, [list(srcs) for srcs in sources]
+
     table = ElementTable(sim_fn, list_of_lists, anchor_list=reference_list_idx)
 
     if reference_list_idx is not None:
         anchor = list_of_lists[reference_list_idx]
         reference = [(reference_list_idx, i) for i in range(len(anchor))]
         aligned = _assign_to_reference(table, reference, threshold=0.0)
-        return aligned, _original_positions(aligned, list_of_lists)
-
-    threshold = _compute_dynamic_threshold(table)
-    reference = _elect_reference(table, threshold, min_support_ratio, refinement_rounds)
-    aligned = _assign_to_reference(table, reference, threshold=0.95 * threshold)
-    aligned = _prune_low_support_elements(aligned, min_support_ratio)
-    return sort_by_original_majority(aligned, list_of_lists)
+        sources = _original_positions(aligned, list_of_lists)
+    else:
+        threshold = _compute_dynamic_threshold(table)
+        reference = _elect_reference(table, threshold, min_support_ratio, refinement_rounds)
+        aligned = _assign_to_reference(table, reference, threshold=0.95 * threshold)
+        aligned = _prune_low_support_elements(aligned, min_support_ratio)
+        aligned, sources = sort_by_original_majority(aligned, list_of_lists)
+    if key is not None:
+        cache.set(key, [list(srcs) for srcs in sources])
+    return aligned, sources

@@ -109,7 +109,10 @@ def _consensus_over_contents(
 ):
     """Shared align-then-vote step over parsed choice contents."""
     if len(contents) >= 2:
-        scorer.prefetch_embeddings(_collect_strings(contents))
+        # Pre-alignment hook: host scorers batch-prefetch embeddings; the
+        # device scorer additionally computes all pairwise field similarities
+        # in batched JAX kernels on the chip (consensus/device.py).
+        scorer.prepare(contents)
         if consensus_settings.aligner == "key":
             # Swap point (reference `consolidation.py:22`): key-based aligner
             # behind the same signature.
@@ -128,6 +131,11 @@ def _consensus_over_contents(
                 refinement_rounds=consensus_settings.effective_refinement_rounds,
             )
         contents = list(aligned_seq)
+        if not (consensus_settings.likelihood_weighting and weights):
+            # Post-alignment hook: the device scorer batch-votes the aligned
+            # enum columns in one kernel call (host scorers: no-op). Weighted
+            # voting stays host-side, so skip the prefill there.
+            scorer.prepare_aligned(contents, consensus_settings)
     return consensus_values(
         contents,
         consensus_settings,

@@ -1,0 +1,662 @@
+"""On-device consensus: batched JAX kernels for the consolidation hot path.
+
+The host consensus engine (alignment.py / voting.py / primitive.py) is pure
+Python over one field pair or one vote column at a time — ~16 ms warm per n=32
+request (BENCH_r05 ``host_consensus``), serialized behind the GIL. This module
+ports the three hot kernels to batched, jittable JAX so the per-request
+similarity and voting work runs as a handful of chip dispatches:
+
+- **Batched Levenshtein** (:func:`batched_levenshtein`): every unique string
+  pair in a consolidation, scored in one padded ``[pairs, L]`` scan. The row-DP
+  insertion chain (``new_row[i] = min(new_row[i-1]+1, ...)``) is solved as a
+  min-plus prefix scan — ``cummin(d - idx) + idx`` — so each of the L scan
+  steps is fully vectorized across pairs and row positions.
+- **Batched majority vote** (:func:`batched_votes`): all enum-like aligned
+  columns of a consolidation tallied in one ``[fields, samples, candidates]``
+  one-hot reduction, including the canonical-spelling election (spelling
+  counts masked to the winning sanitized bucket).
+- **Greedy assignment scan** (:func:`device_best_match_scores`): the
+  ``_best_match_scores`` claim loop behind the alignment threshold as a
+  ``lax.scan``, for chip deployments; the production host path keeps float64
+  numpy here because f32 similarity re-derivation could flip threshold ties.
+
+Equivalence architecture (pinned by tests/test_device_consensus.py): kernels
+compute only **integers** — edit distances, tallies, winner indices. Every
+float the consensus pipeline consumes (similarities, confidences) is derived
+host-side in float64 by the *same expressions* the host path uses
+(``max(1e-8, 1 - dist/max_len)``, ``parent * count / total``), so device
+results are bit-identical to host results, not merely within tolerance.
+Structure extraction and re-assembly stay on host: tree flatten → padded
+device arrays → align/vote on device → unflatten.
+
+:class:`DeviceSimilarityScorer` is the integration point: ``TpuBackend``
+constructs it (``device_consensus`` config, default on) instead of the plain
+``SimilarityScorer``. Its ``prepare()`` hook walks the parsed contents into
+per-path string buckets, scores each bucket's unique pairs on device, and
+publishes the results in a per-consolidation session consulted by ``string()``
+before any TTL-cache lock. A persistent bucket-level cache (``pairs`` in
+``cache_stats()``) lets warm repeats skip the device round-trip entirely.
+Fallback to the host path is automatic and observable (CONSENSUS_EVENTS):
+JAX/device unavailable, chip lock busy, unsupported payload shapes, any kernel
+error, or the ``consensus.device=fallback:N`` failpoint.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..native import levenshtein_distance
+from ..reliability import failpoints as _failpoints
+from ..utils.observability import CONSENSUS_EVENTS
+from .cache import TTLCache
+from .settings import (
+    SIMILARITY_SCORE_LOWER_BOUND,
+    SPECIAL_FIELD_PREFIXES,
+)
+from .similarity import EMBEDDING_MIN_CHARS, SimilarityScorer, cosine_similarity
+from .text import (
+    hamming_similarity,
+    jaccard_similarity,
+    normalize_string,
+    sanitize_value,
+)
+from .voting import vote_memo_key
+
+logger = logging.getLogger(__name__)
+
+#: Longest normalized string the Levenshtein kernel handles; longer pairs (and
+#: anything else the encoder can't express) take the host native path.
+LEV_MAX_LEN = 128
+#: Pair-axis padding buckets: pow2 between these bounds, so jit compiles a
+#: small, bounded set of shapes instead of one per workload size.
+_PAIR_MIN_BUCKET = 64
+_PAIR_CHUNK = 1024
+#: Vote kernel fixed shape: up to 128 samples / 128 distinct spellings per
+#: column, fields chunked by 8 — a single compiled shape for every workload.
+VOTE_MAX_SAMPLES = 128
+_VOTE_FIELD_CHUNK = 8
+#: Refuse to device-score a bucket above this many pairs (payload-shape guard).
+_MAX_BUCKET_PAIRS = 100_000
+
+
+class DeviceConsensusUnavailable(RuntimeError):
+    """JAX (or a device) is not importable/usable; callers fall back to host."""
+
+
+_jax_state: Optional[Tuple[bool, Any]] = None
+_jax_state_lock = threading.Lock()
+
+
+def _require_jax():
+    """Import jax once; raise :class:`DeviceConsensusUnavailable` if it (or a
+    backend device) is missing. The verdict is memoized either way."""
+    global _jax_state
+    if _jax_state is None:
+        with _jax_state_lock:
+            if _jax_state is None:
+                try:
+                    import jax
+
+                    jax.devices()
+                    _jax_state = (True, jax)
+                except Exception as e:  # pragma: no cover - env without jax
+                    _jax_state = (False, f"{type(e).__name__}: {e}")
+    ok, payload = _jax_state
+    if not ok:
+        raise DeviceConsensusUnavailable(payload)
+    return payload
+
+
+def device_available() -> bool:
+    try:
+        _require_jax()
+        return True
+    except DeviceConsensusUnavailable:
+        return False
+
+
+def _pow2_bucket(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Kernel 1: batched Levenshtein distance
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _lev_kernel(length: int):
+    """Jitted row-DP Levenshtein over ``[P, length]`` code arrays.
+
+    Scans the columns of ``b``; the carry is the DP row ``D[·][j]`` for all P
+    pairs at once. The in-row insertion recurrence is the min-plus prefix scan
+    ``cummin(d - idx) + idx``. Padding-safe: the result is read at column
+    ``blen`` and row position ``alen``, which only depend on real characters.
+    """
+    jax = _require_jax()
+    import jax.numpy as jnp
+    from jax import lax
+
+    L = length
+
+    def kernel(a, alen, b, blen):
+        P = a.shape[0]
+        idx = jnp.arange(L + 1, dtype=jnp.int32)
+        row0 = jnp.broadcast_to(idx, (P, L + 1))
+        res0 = alen.astype(jnp.int32)
+
+        def step(carry, inp):
+            j, bj = inp
+            row, res = carry
+            sub = row[:, :-1] + (a != bj[:, None]).astype(jnp.int32)
+            dele = row[:, 1:] + 1
+            d = jnp.concatenate(
+                [jnp.full((P, 1), j + 1, dtype=jnp.int32), jnp.minimum(sub, dele)],
+                axis=1,
+            )
+            new_row = lax.cummin(d - idx[None, :], axis=1) + idx[None, :]
+            got = jnp.take_along_axis(new_row, alen[:, None], axis=1)[:, 0]
+            res = jnp.where(j + 1 == blen, got, res)
+            return (new_row, res), None
+
+        xs = (jnp.arange(L, dtype=jnp.int32), jnp.swapaxes(b, 0, 1))
+        (_, res), _ = lax.scan(step, (row0, res0), xs)
+        return res
+
+    return jax.jit(kernel)
+
+
+def _encode_ascii(strs: List[str], length: int) -> Tuple[np.ndarray, np.ndarray]:
+    arr = np.zeros((len(strs), length), dtype=np.int32)
+    lens = np.zeros(len(strs), dtype=np.int32)
+    for i, s in enumerate(strs):
+        raw = np.frombuffer(s.encode("ascii"), dtype=np.uint8)
+        arr[i, : raw.size] = raw
+        lens[i] = raw.size
+    return arr, lens
+
+
+def batched_levenshtein(pairs: List[Tuple[str, str]]) -> List[int]:
+    """Exact Levenshtein distances for ASCII string pairs, batched on device.
+
+    Strings must already be normalized (``normalize_string``) and no longer
+    than :data:`LEV_MAX_LEN`. Pairs are grouped into power-of-two length
+    buckets and chunked along the pair axis so jit compiles a bounded shape
+    set. Returns plain Python ints, identical to the host native kernel.
+    """
+    results = [0] * len(pairs)
+    buckets: Dict[int, List[int]] = {}
+    for i, (a, b) in enumerate(pairs):
+        L = _pow2_bucket(max(len(a), len(b), 1), 8, LEV_MAX_LEN)
+        buckets.setdefault(L, []).append(i)
+    for L, idxs in buckets.items():
+        kern = _lev_kernel(L)
+        for start in range(0, len(idxs), _PAIR_CHUNK):
+            chunk = idxs[start : start + _PAIR_CHUNK]
+            P = _pow2_bucket(len(chunk), _PAIR_MIN_BUCKET, _PAIR_CHUNK)
+            a_s = [pairs[i][0] for i in chunk] + [""] * (P - len(chunk))
+            b_s = [pairs[i][1] for i in chunk] + [""] * (P - len(chunk))
+            a, alen = _encode_ascii(a_s, L)
+            b, blen = _encode_ascii(b_s, L)
+            out = np.asarray(kern(a, alen, b, blen))
+            for j, i in enumerate(chunk):
+                results[i] = int(out[j])
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2: batched majority vote over aligned columns
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _vote_kernel():
+    """Jitted two-level tally: sanitized-bucket counts pick the winner, then
+    exact-spelling counts (masked to the winning bucket) pick the reported
+    spelling. ``argmax`` first-hit ties equal first-insertion order, matching
+    ``Counter.most_common(1)`` (heapq.nlargest is stable) and the host's
+    first-occurrence spelling rule, because ids are assigned first-seen."""
+    jax = _require_jax()
+    import jax.numpy as jnp
+
+    def kernel(codes, spell, spell_bucket):
+        # codes/spell: [F, S] int32 ids (-1 = absent/padding);
+        # spell_bucket: [F, U] int32 bucket of each spelling id (-1 = padding)
+        U = spell_bucket.shape[1]
+        cand = jnp.arange(U, dtype=jnp.int32)
+        b_counts = (codes[:, None, :] == cand[None, :, None]).sum(axis=-1)
+        winner = jnp.argmax(b_counts, axis=1).astype(jnp.int32)
+        wcount = jnp.take_along_axis(b_counts, winner[:, None], axis=1)[:, 0]
+        s_counts = (spell[:, None, :] == cand[None, :, None]).sum(axis=-1)
+        eligible = spell_bucket == winner[:, None]
+        masked = jnp.where(eligible, s_counts, -1)
+        wspell = jnp.argmax(masked, axis=1).astype(jnp.int32)
+        return winner, wcount, wspell
+
+    return jax.jit(kernel)
+
+
+class _VoteColumn:
+    """Host-side encoding of one vote-eligible aligned column."""
+
+    __slots__ = ("key", "codes", "spell", "bucket_of_spell", "spell_values", "valid", "is_bool", "canonical")
+
+    def __init__(self, key, codes, spell, bucket_of_spell, spell_values, valid, is_bool, canonical):
+        self.key = key
+        self.codes = codes  # sanitized-bucket id per valid sample
+        self.spell = spell  # spelling id per valid sample
+        self.bucket_of_spell = bucket_of_spell  # spelling id -> bucket id
+        self.spell_values = spell_values  # spelling id -> original value
+        self.valid = valid  # the values that actually vote, in order
+        self.is_bool = is_bool
+        self.canonical = canonical  # effective_canonical_spelling at encode time
+
+
+def _encode_vote_column(values: List[Any], consensus_settings) -> Optional[_VoteColumn]:
+    """Encode a column for the vote kernel, or None when the host must do it.
+
+    Mirrors ``voting_consensus`` exactly: booleans vote over ``v or False``
+    with None as False; strings vote under ``sanitize_value`` with None a
+    distinct candidate only when ``allow_none_as_candidate``. Columns mixing
+    bools and strings (or exceeding the kernel shape) are not encoded.
+    """
+    key = vote_memo_key(values, consensus_settings)
+    if key is None or not values or len(values) > VOTE_MAX_SAMPLES:
+        return None
+    non_none = [v for v in values if v is not None]
+    if not non_none:
+        return None
+    is_bool = isinstance(non_none[0], bool)
+    if is_bool:
+        if not all(isinstance(v, bool) for v in non_none):
+            return None
+        valid: List[Any] = [v or False for v in values]
+        proc: List[Any] = valid
+    else:
+        if not all(isinstance(v, str) for v in non_none):
+            return None
+        valid = list(values) if consensus_settings.allow_none_as_candidate else non_none
+        proc = [sanitize_value(v) if v is not None else None for v in valid]
+
+    bucket_ids: Dict[Any, int] = {}
+    codes = []
+    for p in proc:
+        if p not in bucket_ids:
+            bucket_ids[p] = len(bucket_ids)
+        codes.append(bucket_ids[p])
+    spell_ids: Dict[Any, int] = {}
+    spell = []
+    spell_values: List[Any] = []
+    bucket_of_spell: List[int] = []
+    for v, c in zip(valid, codes):
+        if v not in spell_ids:
+            spell_ids[v] = len(spell_ids)
+            spell_values.append(v)
+            bucket_of_spell.append(c)
+        spell.append(spell_ids[v])
+    if len(spell_values) > VOTE_MAX_SAMPLES:
+        return None
+    return _VoteColumn(
+        key,
+        codes,
+        spell,
+        bucket_of_spell,
+        spell_values,
+        valid,
+        is_bool,
+        bool(consensus_settings.effective_canonical_spelling),
+    )
+
+
+def batched_votes(columns: List[_VoteColumn]) -> List[Tuple[Any, int]]:
+    """Run the vote kernel over encoded columns; returns (best_val, best_count)
+    per column, field-chunked into the kernel's single compiled shape."""
+    S = VOTE_MAX_SAMPLES
+    kern = _vote_kernel()
+    out: List[Tuple[Any, int]] = []
+    for start in range(0, len(columns), _VOTE_FIELD_CHUNK):
+        chunk = columns[start : start + _VOTE_FIELD_CHUNK]
+        F = _VOTE_FIELD_CHUNK
+        codes = np.full((F, S), -1, dtype=np.int32)
+        spell = np.full((F, S), -1, dtype=np.int32)
+        bucket = np.full((F, S), -1, dtype=np.int32)
+        for f, col in enumerate(chunk):
+            codes[f, : len(col.codes)] = col.codes
+            spell[f, : len(col.spell)] = col.spell
+            bucket[f, : len(col.bucket_of_spell)] = col.bucket_of_spell
+        winner, wcount, wspell = (np.asarray(x) for x in kern(codes, spell, bucket))
+        for f, col in enumerate(chunk):
+            w, c, ws = int(winner[f]), int(wcount[f]), int(wspell[f])
+            out.append((_decode_vote(col, w, c, ws), c))
+    return out
+
+
+def _decode_vote(col: _VoteColumn, winner: int, count: int, wspell: int):
+    if col.is_bool or col.canonical:
+        # Canonical-spelling election happened in the kernel (spelling counts
+        # masked to the winning bucket; argmax = most common, first-seen on
+        # ties). Booleans: spelling ids coincide with bucket ids, so this is
+        # exactly the host branch's Counter winner.
+        return col.spell_values[wspell]
+    # Canonical spelling off: the host reports the winning bucket's first
+    # occurrence (valid_values[processed.index(best_normalized)]).
+    return next(v for v, c in zip(col.valid, col.codes) if c == winner)
+
+
+# ---------------------------------------------------------------------------
+# Kernel 3: greedy assignment scan (chip port of _best_match_scores)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _greedy_match_kernel(n: int):
+    """Jitted port of ``alignment._best_match_scores``: scan rows in order;
+    each element claims its best still-unclaimed partner from a later list
+    above the 0.5 base threshold; claims reset per source list (owner ids are
+    contiguous and nondecreasing, so reset-on-owner-change is equivalent)."""
+    jax = _require_jax()
+    import jax.numpy as jnp
+    from jax import lax
+
+    def kernel(sim, owner):
+        def step(carry, r):
+            claimed, prev = carry
+            src = owner[r]
+            claimed = jnp.where(src != prev, jnp.zeros_like(claimed), claimed)
+            pool = (owner > src) & jnp.logical_not(claimed)
+            sims = jnp.where(pool, sim[r], -jnp.inf)
+            p = jnp.argmax(sims)
+            ok = sims[p] > 0.5
+            claimed = claimed.at[p].set(claimed[p] | ok)
+            return (claimed, src), jnp.where(ok, sims[p], jnp.nan)
+
+        init = (jnp.zeros(n, dtype=bool), jnp.int32(-1))
+        _, scores = lax.scan(step, init, jnp.arange(n, dtype=jnp.int32))
+        return scores
+
+    return jax.jit(kernel)
+
+
+def device_best_match_scores(sim: np.ndarray, owner: np.ndarray) -> List[float]:
+    """Greedy best-match score distribution, computed on device.
+
+    Validated against the host scan in the differential suite; the production
+    alignment path stays on host float64 (see module docstring) — this is the
+    chip-deployment entry point for the assignment kernel.
+    """
+    n = sim.shape[0]
+    if n == 0:
+        return []
+    N = _pow2_bucket(n, 8, 1 << 14)
+    sim_p = np.full((N, N), -1.0, dtype=np.float32)
+    sim_p[:n, :n] = sim
+    owner_p = np.full(N, np.iinfo(np.int32).max, dtype=np.int32)
+    owner_p[:n] = owner
+    scores = np.asarray(_greedy_match_kernel(N)(sim_p, owner_p))[:n]
+    return [float(s) for s in scores if not np.isnan(s)]
+
+
+# ---------------------------------------------------------------------------
+# Session + scorer integration
+# ---------------------------------------------------------------------------
+
+
+class DeviceConsensusSession:
+    """Per-consolidation similarity table published by ``prepare()``: every
+    unique in-bucket string pair, pre-scored (device batch, bucket cache, or
+    host fallback) and consulted lock-free by ``string()``."""
+
+    __slots__ = ("pair_sims", "hits", "misses")
+
+    def __init__(self) -> None:
+        self.pair_sims: Dict[Tuple[str, str], float] = {}
+        self.hits = 0
+        self.misses = 0
+
+
+def _collect_string_buckets(contents: List[Any]) -> Dict[str, List[str]]:
+    """Group scalar strings by structural path (list indices collapsed to
+    ``*``, mirroring ``key_normalization``): alignment and consensus only ever
+    compare strings within the same collapsed path."""
+    buckets: Dict[str, List[str]] = {}
+
+    def walk(node: Any, path: str) -> None:
+        if isinstance(node, str):
+            buckets.setdefault(path, []).append(node)
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{path}.{k}" if path else str(k))
+        elif isinstance(node, (list, tuple)):
+            child = f"{path}.*" if path else "*"
+            for v in node:
+                walk(v, child)
+
+    for content in contents:
+        walk(content, "")
+    return buckets
+
+
+class DeviceSimilarityScorer(SimilarityScorer):
+    """SimilarityScorer whose consolidation hooks run the batched kernels.
+
+    Construction raises :class:`DeviceConsensusUnavailable` when JAX is
+    missing, so ``TpuBackend`` degrades to the plain host scorer at wiring
+    time. At run time every consolidation independently falls back to host on
+    the ``consensus.device`` failpoint, a busy chip lock, unsupported payload
+    shapes, or any kernel error — recorded in CONSENSUS_EVENTS either way.
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        _require_jax()
+        super().__init__(*args, **kwargs)
+        # Persistent bucket-level pair cache: key = sorted unique strings of a
+        # bucket, value = the scored pair map. Warm repeats skip the device.
+        self._bucket_cache = TTLCache(maxsize=4096, ttl=300.0, name="pairs")
+        self._tls = threading.local()
+        self._device_lock = threading.Lock()  # chip-busy gate (non-blocking)
+        self.cache_enabled = True  # bench toggle (cache on/off axis)
+
+    # -- consolidation hooks ----------------------------------------------
+    def prepare(self, contents: List[Any]) -> None:
+        self._tls.session = None
+        spec = _failpoints.fire("consensus.device")
+        if spec is not None and spec.action == "fallback":
+            CONSENSUS_EVENTS.record("consensus.fallback_failpoint")
+            self._fall_back_to_host(contents)
+            return
+        try:
+            super().prepare(contents)  # embedding prefetch (one batched call)
+            session = DeviceConsensusSession()
+            self._build_pair_sims(contents, session)
+            self._tls.session = session
+            CONSENSUS_EVENTS.record("consensus.device_dispatch")
+        except DeviceConsensusUnavailable:
+            CONSENSUS_EVENTS.record("consensus.fallback_unavailable")
+            self._fall_back_to_host(contents)
+        except Exception:
+            logger.exception("device consensus prepare failed; using host path")
+            CONSENSUS_EVENTS.record("consensus.fallback_error")
+            self._fall_back_to_host(contents)
+
+    def _fall_back_to_host(self, contents: List[Any]) -> None:
+        self._tls.session = None
+        CONSENSUS_EVENTS.record("consensus.host_dispatch")
+        try:
+            super().prepare(contents)
+        except Exception:  # prefetch is best-effort on the fallback path too
+            logger.exception("host prepare failed during device fallback")
+
+    def prepare_aligned(self, contents: List[Any], consensus_settings: Any) -> None:
+        session = getattr(self._tls, "session", None)
+        if session is None:
+            return
+        try:
+            self._prefill_votes(list(contents), consensus_settings)
+        except Exception:
+            # Voting falls back lazily: any column missing from the memo is
+            # simply computed by the host voting_consensus.
+            logger.exception("device vote prefill failed; host voting takes over")
+            CONSENSUS_EVENTS.record("consensus.fallback_error")
+
+    # -- similarity lookup -------------------------------------------------
+    def string(self, s1: str, s2: str) -> float:
+        session = getattr(self._tls, "session", None)
+        if session is not None:
+            key = (s1, s2) if s1 <= s2 else (s2, s1)
+            sim = session.pair_sims.get(key)
+            if sim is not None:
+                session.hits += 1
+                return sim
+            session.misses += 1
+        return super().string(s1, s2)
+
+    # -- device work -------------------------------------------------------
+    def _build_pair_sims(self, contents: List[Any], session: DeviceConsensusSession) -> None:
+        for values in _collect_string_buckets(contents).values():
+            unique = list(dict.fromkeys(values))
+            if len(unique) < 2:
+                continue
+            if len(unique) * (len(unique) - 1) // 2 > _MAX_BUCKET_PAIRS:
+                continue  # unsupported payload shape: host scores lazily
+            bucket_key = (self.method, tuple(sorted(unique)))
+            if self.cache_enabled:
+                cached = self._bucket_cache.get(bucket_key)
+                if cached is not None:
+                    session.pair_sims.update(cached)
+                    CONSENSUS_EVENTS.record("consensus.cached_pairs", len(cached))
+                    continue
+            pair_map = self._score_bucket(unique)
+            if self.cache_enabled:
+                self._bucket_cache.set(bucket_key, pair_map)
+            session.pair_sims.update(pair_map)
+
+    def _score_bucket(self, unique: List[str]) -> Dict[Tuple[str, str], float]:
+        """Score every unordered pair of a bucket, routing Levenshtein work to
+        the device and keeping float derivation bit-identical to the host."""
+        pair_map: Dict[Tuple[str, str], float] = {}
+        lev_jobs: List[Tuple[Tuple[str, str], str, str, int]] = []
+        host_pairs = 0
+        for i, s1 in enumerate(unique):
+            for s2 in unique[i + 1 :]:
+                key = (s1, s2) if s1 <= s2 else (s2, s1)
+                if key in pair_map:
+                    continue
+                sim = self._score_host_only(s1, s2)
+                if sim is not None:
+                    pair_map[key] = sim
+                    host_pairs += 1
+                    continue
+                n1, n2 = normalize_string(s1), normalize_string(s2)
+                max_len = max(len(n1), len(n2))
+                if max_len == 0:
+                    pair_map[key] = 1.0
+                elif max_len > LEV_MAX_LEN:
+                    # payload shape the kernel doesn't cover: host native
+                    dist = levenshtein_distance(n1, n2)
+                    pair_map[key] = max(SIMILARITY_SCORE_LOWER_BOUND, 1 - (dist / max_len))
+                    host_pairs += 1
+                else:
+                    lev_jobs.append((key, n1, n2, max_len))
+        if lev_jobs:
+            dists = self._lev_distances([(n1, n2) for _, n1, n2, _ in lev_jobs])
+            for (key, _, _, max_len), dist in zip(lev_jobs, dists):
+                pair_map[key] = max(SIMILARITY_SCORE_LOWER_BOUND, 1 - (dist / max_len))
+        if host_pairs:
+            CONSENSUS_EVENTS.record("consensus.host_pairs", host_pairs)
+        return pair_map
+
+    def _score_host_only(self, s1: str, s2: str) -> Optional[float]:
+        """Methods the device doesn't kernelize, computed here so the bucket
+        cache still memoizes them. Returns None for the Levenshtein route."""
+        if self.method == "jaccard":
+            return jaccard_similarity(s1, s2)
+        if self.method == "hamming":
+            return hamming_similarity(s1, s2)
+        if (
+            self.method == "embeddings"
+            and len(s1) > EMBEDDING_MIN_CHARS
+            and len(s2) > EMBEDDING_MIN_CHARS
+            and self.embed_fn is not None
+        ):
+            try:
+                return cosine_similarity(self.get_embedding(s1), self.get_embedding(s2))
+            except Exception as e:  # degrade to Levenshtein, like string()
+                logger.error("Error getting embeddings for %r and %r", s1, s2, exc_info=e)
+        return None
+
+    def _lev_distances(self, pairs: List[Tuple[str, str]]) -> List[int]:
+        """Batched device Levenshtein; host native when the chip lock is busy
+        (another thread mid-kernel) so consolidations never queue on it."""
+        if self._device_lock.acquire(blocking=False):
+            try:
+                dists = batched_levenshtein(pairs)
+                CONSENSUS_EVENTS.record("consensus.device_pairs", len(pairs))
+                return dists
+            finally:
+                self._device_lock.release()
+        CONSENSUS_EVENTS.record("consensus.device_busy")
+        CONSENSUS_EVENTS.record("consensus.host_pairs", len(pairs))
+        return [levenshtein_distance(a, b) for a, b in pairs]
+
+    def _prefill_votes(self, contents: List[Any], consensus_settings: Any) -> None:
+        """Batch-tally every vote-eligible aligned column into the vote memo,
+        mirroring the consensus_values dispatch gates. Columns the encoder
+        skips (mixed types, too wide) are computed lazily by the host."""
+        columns: List[List[Any]] = []
+
+        def walk(values: List[Any]) -> None:
+            present = [v for v in values if v is not None]
+            if not present:
+                return
+            if isinstance(present[0], (str, bool)) and all(
+                len(str(v).strip().split()) < 3 for v in present
+            ):
+                columns.append(list(values))
+                return
+            if isinstance(present[0], dict):
+                kept = [v for v in values if isinstance(v, dict)]
+                for key in dict.fromkeys(k for d in kept for k in d):
+                    if any(marker in key for marker in SPECIAL_FIELD_PREFIXES):
+                        continue
+                    walk([d.get(key) for d in kept])
+                return
+            if isinstance(present[0], list):
+                kept = [v for v in values if isinstance(v, list)]
+                width = max((len(lst) for lst in kept), default=0)
+                for col in range(width):
+                    walk([lst[col] if col < len(lst) else None for lst in kept])
+
+        walk(contents)
+        jobs: List[_VoteColumn] = []
+        for column in columns:
+            enc = _encode_vote_column(column, consensus_settings)
+            if enc is None or self._vote_cache.get(enc.key) is not None:
+                continue
+            jobs.append(enc)
+        if not jobs:
+            return
+        if not self._device_lock.acquire(blocking=False):
+            CONSENSUS_EVENTS.record("consensus.device_busy")
+            return
+        try:
+            results = batched_votes(jobs)
+        finally:
+            self._device_lock.release()
+        for col, (best_val, best_count) in zip(jobs, results):
+            if best_count > 0:
+                self._vote_cache.set(col.key, (best_val, best_count))
+        CONSENSUS_EVENTS.record("consensus.device_votes", len(jobs))
+
+    # -- observability -----------------------------------------------------
+    def cache_stats(self) -> dict:
+        stats = super().cache_stats()
+        stats["pairs"] = self._bucket_cache.stats()
+        return stats

@@ -22,7 +22,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 from .settings import ConsensusSettings
-from .similarity import SimilarityScorer
+from .similarity import SimilarityScorer, freeze_key
 
 LlmConsensusFn = Callable[[List[str]], str]
 
@@ -72,9 +72,38 @@ def _finite_floats(values: List[Any]) -> np.ndarray:
 
 
 def _numeric_consensus(
+    values: List[Any],
+    settings: ConsensusSettings,
+    parent_valid_frac: float,
+    scorer: Optional[SimilarityScorer] = None,
+) -> Tuple[Optional[float], float]:
+    """Hybrid numeric consensus with None-aware confidence (spec :1098-1219).
+
+    Pure in ``values``+tolerances except the empty-payload early return (the
+    only branch that reads ``parent_valid_frac``), so results are memoized on
+    the scorer's numeric cache with that branch stored as a sentinel."""
+    cache = getattr(scorer, "_numeric_cache", None)
+    key = None
+    if cache is not None:
+        frozen = freeze_key(values)
+        if frozen is not None:
+            key = (frozen, settings.rel_eps, settings.abs_eps)
+            hit = cache.get(key)
+            if hit is not None:
+                if hit == "empty":
+                    return None, parent_valid_frac
+                return hit
+
+    result = _numeric_consensus_uncached(values, settings, parent_valid_frac)
+    if key is not None:
+        xs_empty = result == (None, parent_valid_frac) and _finite_floats(values).size == 0
+        cache.set(key, "empty" if xs_empty else result)
+    return result
+
+
+def _numeric_consensus_uncached(
     values: List[Any], settings: ConsensusSettings, parent_valid_frac: float
 ) -> Tuple[Optional[float], float]:
-    """Hybrid numeric consensus with None-aware confidence (spec :1098-1219)."""
     total = len(values)
     none_count = sum(v is None for v in values)
 
@@ -150,6 +179,16 @@ def _medoid_consensus(
     max mean break toward the most frequent exact value among the tied
     candidates instead of np.argmax's first-index rule — normalized-identical
     case variants stop winning on position."""
+    cache = getattr(scorer, "_medoid_cache", None)
+    key = None
+    if cache is not None:
+        frozen = freeze_key(values)
+        if frozen is not None:
+            key = (frozen, bool(canonical_spelling))
+            hit = cache.get(key)
+            if hit is not None:
+                best_idx, mean = hit
+                return values[best_idx], round(parent_valid_frac * mean, 5)
     sim = _pairwise_matrix(values, scorer, diag=np.nan)
     mean_to_others = np.nanmean(sim, axis=1)
     best = int(np.argmax(mean_to_others))
@@ -159,6 +198,8 @@ def _medoid_consensus(
             freq: Counter = Counter(repr(values[i]) for i in tied)
             top = max(freq[repr(values[i])] for i in tied)
             best = int(next(i for i in tied if freq[repr(values[i])] == top))
+    if key is not None:
+        cache.set(key, (best, float(mean_to_others[best])))
     return values[best], round(parent_valid_frac * float(mean_to_others[best]), 5)
 
 
@@ -258,7 +299,7 @@ def consensus_as_primitive(
 
     # (b) hybrid numeric consensus with None-aware confidence.
     if _looks_numeric(non_none):
-        return _numeric_consensus(values, consensus_settings, parent_valid_frac)
+        return _numeric_consensus(values, consensus_settings, parent_valid_frac, scorer=scorer)
 
     # (c) similarity medoid (strings or other structures).
     return _medoid_consensus(

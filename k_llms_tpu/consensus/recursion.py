@@ -20,6 +20,25 @@ from __future__ import annotations
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
+
+def _copy_tree(v: Any) -> Any:
+    """deepcopy fast path for parsed-JSON trees (dict/list/tuple/scalars).
+
+    deepcopy's generic machinery (memo dict, reductor dispatch) measured ~2.6 ms
+    per warm n=32 consolidation; parsed contents are almost always plain JSON,
+    which this covers directly. Exotic nodes fall back to copy.deepcopy.
+    """
+    t = type(v)
+    if t is dict:
+        return {k: _copy_tree(x) for k, x in v.items()}
+    if t is list:
+        return [_copy_tree(x) for x in v]
+    if t is tuple:
+        return tuple(_copy_tree(x) for x in v)
+    if v is None or t in (str, int, float, bool):
+        return v
+    return deepcopy(v)
+
 from .alignment import lists_alignment
 from .primitive import LlmConsensusFn, consensus_as_primitive
 from .settings import SPECIAL_FIELD_PREFIXES, ConsensusSettings
@@ -153,7 +172,7 @@ def recursive_list_alignments(
     if all(v is None for v in values):
         return values, {current_path: [current_path] * len(values)}
 
-    values = deepcopy(values)  # descent helpers mutate nested structure
+    values = _copy_tree(values)  # descent helpers mutate nested structure
     present = [v for v in values if v is not None]
     head = type(present[0])
     uniform = all(isinstance(v, head) for v in present)
@@ -207,7 +226,11 @@ def consensus_values(
         len(str(v).strip().split()) < 3 for v in present
     ):
         return voting_consensus(
-            values, consensus_settings, parent_valid_frac=parent_valid_frac, weights=weights
+            values,
+            consensus_settings,
+            parent_valid_frac=parent_valid_frac,
+            weights=weights,
+            scorer=scorer,
         )
 
     for shape, handler in ((dict, consensus_dict), (list, consensus_list)):

@@ -46,6 +46,58 @@ def _key_ignored(k: str) -> bool:
     per key per PAIR, which made re.match a measured hot spot at n=32."""
     return any(re.match(p, k) for p in IGNORED_KEY_PATTERNS)
 
+
+class _Unfreezable(Exception):
+    """Value cannot be turned into a hashable memo key (exotic type / too big)."""
+
+
+def _freeze(v: Any, counter: List[int]) -> Any:
+    """Hashable structural snapshot of a JSON-ish value, for memo keys.
+
+    Bools are type-tagged because ``hash(True) == hash(1)`` would otherwise
+    alias bool and int keys. Leaf budget (``counter``) bounds key-build cost so
+    pathological payloads skip the memo instead of paying O(tree) per lookup.
+    """
+    counter[0] -= 1
+    if counter[0] < 0:
+        raise _Unfreezable
+    if isinstance(v, bool):
+        return ("b", v)
+    if v is None or isinstance(v, (str, int, float)):
+        return v
+    if isinstance(v, dict):
+        try:
+            items = sorted(v.items())
+        except TypeError as e:  # non-sortable keys
+            raise _Unfreezable from e
+        return ("d", tuple((k, _freeze(val, counter)) for k, val in items))
+    if isinstance(v, (list, tuple)):
+        return ("l", tuple(_freeze(x, counter) for x in v))
+    raise _Unfreezable
+
+
+def freeze_key(v: Any, budget: int = 256) -> Optional[Any]:
+    """Public memo-key helper: hashable snapshot of ``v`` or None if unsuitable."""
+    try:
+        return _freeze(v, [budget])
+    except _Unfreezable:
+        return None
+
+
+def collect_strings(value: Any, acc: Optional[List[str]] = None) -> List[str]:
+    """All string leaves in a parsed-content tree (for embedding prefetch)."""
+    if acc is None:
+        acc = []
+    if isinstance(value, str):
+        acc.append(value)
+    elif isinstance(value, dict):
+        for v in value.values():
+            collect_strings(v, acc)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            collect_strings(v, acc)
+    return acc
+
 # Embeddings are only worth the trip for long strings (reference :813).
 EMBEDDING_MIN_CHARS = 50
 
@@ -94,8 +146,42 @@ class SimilarityScorer:
     ):
         self.method = method
         self.embed_fn = embed_fn
-        self._sim_cache = TTLCache(maxsize=cache_maxsize, ttl=cache_ttl)
-        self._emb_cache = TTLCache(maxsize=cache_maxsize, ttl=cache_ttl)
+        self._sim_cache = TTLCache(maxsize=cache_maxsize, ttl=cache_ttl, name="similarity")
+        self._emb_cache = TTLCache(maxsize=cache_maxsize, ttl=cache_ttl, name="embeddings")
+        # Host-path memo tables (ISSUE 8 satellite): repeated identical field
+        # values within (and across) consolidations hit these instead of
+        # recomputing votes / medoid scans / numeric consensus / container sims.
+        self._vote_cache = TTLCache(maxsize=4096, ttl=cache_ttl, name="vote")
+        self._medoid_cache = TTLCache(maxsize=4096, ttl=cache_ttl, name="medoid")
+        self._numeric_cache = TTLCache(maxsize=4096, ttl=cache_ttl, name="numeric")
+        # Whole-alignment memo (lists_alignment): frozen input lists ->
+        # source-index table; aligned output is rebuilt from the caller's own
+        # objects, so hits preserve the uncached path's aliasing exactly.
+        self._align_cache = TTLCache(maxsize=2048, ttl=cache_ttl, name="align")
+
+    # -- consolidation hooks ----------------------------------------------
+    def prepare(self, contents: List[Any]) -> None:
+        """Pre-alignment hook, called once per consolidation with the parsed
+        contents. Host path: batch-prefetch embeddings. The device scorer
+        overrides this to additionally build its batched pair-similarity
+        session on the chip."""
+        self.prefetch_embeddings(collect_strings(contents))
+
+    def prepare_aligned(self, contents: List[Any], consensus_settings: Any) -> None:
+        """Post-alignment hook: the device scorer batch-computes majority
+        votes for the aligned columns here. Host path: no-op."""
+
+    def cache_stats(self) -> dict:
+        """Per-cache counters, keyed by cache name (see TTLCache.stats())."""
+        caches = (
+            self._sim_cache,
+            self._emb_cache,
+            self._vote_cache,
+            self._medoid_cache,
+            self._numeric_cache,
+            self._align_cache,
+        )
+        return {c.name: c.stats() for c in caches}
 
     # -- embeddings -------------------------------------------------------
     def prefetch_embeddings(self, texts: List[str]) -> None:
@@ -196,11 +282,43 @@ class SimilarityScorer:
         elif isinstance(v1, NumericalPrimitive) and isinstance(v2, NumericalPrimitive):
             return numerical_similarity(v1, v2)
         elif isinstance(v1, dict) and isinstance(v2, dict):
-            return self.dict(v1, v2)
+            key = self._container_pair_key(v1, v2)
+            if key is not None:
+                cached = self._sim_cache.get(key)
+                if cached is not None:
+                    return cached
+            result = self.dict(v1, v2)
+            if key is not None:
+                self._sim_cache.set(key, result)
+            return result
         elif isinstance(v1, (list, tuple)) and isinstance(v2, (list, tuple)):
-            return self.list(v1, v2)
+            key = self._container_pair_key(v1, v2)
+            if key is not None:
+                cached = self._sim_cache.get(key)
+                if cached is not None:
+                    return cached
+            result = self.list(v1, v2)
+            if key is not None:
+                self._sim_cache.set(key, result)
+            return result
         else:
             return SIMILARITY_SCORE_LOWER_BOUND
+
+    def _container_pair_key(self, v1: Any, v2: Any):
+        """Symmetric memo key for a container pair, or None when not cacheable.
+
+        generic() is symmetric in its arguments (every branch is), so the key
+        orders the two frozen halves by hash for a canonical form.
+        """
+        f1 = freeze_key(v1)
+        if f1 is None:
+            return None
+        f2 = freeze_key(v2)
+        if f2 is None:
+            return None
+        if hash(f2) < hash(f1):
+            f1, f2 = f2, f1
+        return ("pair", self.method, f1, f2)
 
     # Convenience constructor used by tests and the alignment internals.
     @classmethod

@@ -17,6 +17,7 @@ the distinct ``u<hex>`` tokens.
 
 from __future__ import annotations
 
+import functools
 import re
 from itertools import zip_longest
 
@@ -32,6 +33,7 @@ def ascii_fold(text: str) -> str:
     return transliterate(text)
 
 
+@functools.lru_cache(maxsize=65536)
 def normalize_string(text: str) -> str:
     """Strip non-alphanumeric characters and lowercase."""
     if not text:
@@ -39,12 +41,23 @@ def normalize_string(text: str) -> str:
     return _NON_ALNUM.sub("", text).lower()
 
 
-def sanitize_value(v: str | bool) -> str:
-    """Canonical vote key: str() -> lowercase -> no spaces -> ASCII fold -> alnum."""
+# The memo key includes type(v): hash(True) == hash(1) and True == 1, so a bare
+# lru_cache on the value would hand bool results to ints (and 1.0, etc.).
+@functools.lru_cache(maxsize=65536)
+def _sanitize_hashable(v, _t) -> str:
     s = str(v).lower()
     s = s.replace(" ", "")
     s = ascii_fold(s)
     return _NON_ALNUM.sub("", s)
+
+
+def sanitize_value(v: str | bool) -> str:
+    """Canonical vote key: str() -> lowercase -> no spaces -> ASCII fold -> alnum."""
+    try:
+        return _sanitize_hashable(v, type(v))
+    except TypeError:  # unhashable odd-ball value: compute without the memo
+        s = str(v).lower().replace(" ", "")
+        return _NON_ALNUM.sub("", ascii_fold(s))
 
 
 def key_normalization(key: str) -> str:

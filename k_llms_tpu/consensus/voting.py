@@ -15,7 +15,27 @@ from typing import Optional, Tuple, Union
 from .settings import ConsensusSettings
 from .text import sanitize_value
 
-__all__ = ["voting_consensus", "sanitize_value"]
+__all__ = ["voting_consensus", "sanitize_value", "vote_memo_key"]
+
+
+def vote_memo_key(
+    values: list,
+    consensus_settings: ConsensusSettings,
+) -> Optional[tuple]:
+    """Memo key for an unweighted vote column, or None when not memo-safe.
+
+    Only columns of str/bool/None are keyed: ``hash(True) == hash(1)``, so a
+    bare value tuple would alias bool and numeric columns. The stored payload
+    is ``(best_val, best_count)``; confidence is recomputed at lookup because
+    ``parent_valid_frac`` varies by call site.
+    """
+    if not all(v is None or isinstance(v, (str, bool)) for v in values):
+        return None
+    return (
+        tuple(values),
+        bool(consensus_settings.allow_none_as_candidate),
+        bool(consensus_settings.effective_canonical_spelling),
+    )
 
 
 def voting_consensus(
@@ -23,14 +43,28 @@ def voting_consensus(
     consensus_settings: ConsensusSettings,
     parent_valid_frac: float = 1.0,
     weights: Optional[list[float]] = None,
+    scorer=None,
 ) -> Tuple[Optional[Union[str, bool]], float]:
     """``weights`` (strictly-additional extension): per-sample vote weights —
     the likelihood-weighted mode derives them from sequence logprobs. With
-    weights None every sample votes 1.0, bit-identical to the reference."""
+    weights None every sample votes 1.0, bit-identical to the reference.
+
+    ``scorer`` (optional) supplies the vote memo table (and, on the device
+    path, votes precomputed in one batched kernel call land in that same
+    table keyed by :func:`vote_memo_key`)."""
     total_values = len(values)
 
     if not any(v is not None for v in values):
         return (None, parent_valid_frac)
+
+    cache = getattr(scorer, "_vote_cache", None) if weights is None else None
+    key = vote_memo_key(values, consensus_settings) if cache is not None else None
+    if key is not None:
+        hit = cache.get(key)
+        if hit is not None:
+            best_val, best_count = hit
+            confidence = parent_valid_frac * (best_count / float(total_values))
+            return (best_val, round(confidence, 5))
 
     if weights is None:
         w = [1.0] * total_values
@@ -78,6 +112,9 @@ def voting_consensus(
         else:
             # Report the winner in its original (first-seen) spelling.
             best_val = valid_values[processed_values.index(best_normalized)]
+
+    if key is not None:
+        cache.set(key, (best_val, best_count))
 
     confidence = parent_valid_frac * (best_count / total_weight)
     return (best_val, round(confidence, 5))

@@ -184,6 +184,10 @@ class EngineScheduler:
         self._failovers = 0
         self._hedges = 0
         self._hedges_won = 0
+        # On-device consensus: set by the owning backend to a zero-arg callable
+        # returning cache/dispatch stats; surfaced in stats/health so operators
+        # see consensus cache behaviour next to queue depth.
+        self.consensus_stats_provider: Optional[Callable[[], Dict[str, Any]]] = None
         self._queue_weight = 0
         self._in_flight = 0
         self._state = ServerState.STARTING
@@ -733,9 +737,9 @@ class EngineScheduler:
             return self._state
 
     @property
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
         with self._cv:
-            return {
+            out = {
                 "queued": len(self._items),
                 "served": self._served,
                 "errors": self._errors,
@@ -751,12 +755,25 @@ class EngineScheduler:
                 "hedges": self._hedges,
                 "hedges_won": self._hedges_won,
             }
+        self._attach_consensus(out)
+        return out
+
+    def _attach_consensus(self, out: Dict[str, Any]) -> None:
+        """Merge the backend's consensus snapshot (outside _cv: the provider
+        takes its own locks and must never deadlock or break health)."""
+        prov = self.consensus_stats_provider
+        if prov is None:
+            return
+        try:
+            out["consensus"] = prov()
+        except Exception:  # pragma: no cover - observability must not throw
+            pass
 
     def health(self) -> Dict[str, Any]:
         """Point-in-time lifecycle snapshot, shaped for a /healthz endpoint.
         Cheap (one lock acquisition, no device work)."""
         with self._cv:
-            return {
+            out = {
                 "state": self._state.value,
                 "queue_depth": sum(1 for it in self._items if it is not None),
                 "queue_weight": self._queue_weight,
@@ -780,6 +797,8 @@ class EngineScheduler:
                 "hedges_won": self._hedges_won,
                 "drain_rate": self._drain_rate(),
             }
+        self._attach_consensus(out)
+        return out
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown: close admission (new work gets a typed 503),

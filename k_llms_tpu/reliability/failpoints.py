@@ -44,6 +44,12 @@ Injection sites wired in this package:
                            mid-stream after the first delta chunk, exercising
                            the disconnect → budget-cancel → decode-abort path
                            without a real socket teardown
+- ``consensus.device``   — evaluated at the top of the device-consensus
+                           prepare step (``consensus/device.py``); the
+                           ``fallback`` action forces the scorer to degrade to
+                           the host similarity/voting path for that
+                           consolidation, exercising the automatic-fallback
+                           contract (zero request failures) mid-traffic
 
 Actions (``FailSpec.action``):
 
@@ -74,6 +80,9 @@ Actions (``FailSpec.action``):
 - ``"leak"``         — no-op at the site itself; the paged-KV release path
                        reads ``kill`` and drops that many pages from the free
                        stack unaccounted (a simulated lost decref)
+- ``"fallback"``     — no-op at the site itself; the device-consensus scorer
+                       reads the spec and silently takes the host path for
+                       that consolidation (recording the fallback counters)
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -87,10 +96,11 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="replica.dispatch=down:r1:2,replica.probe=fail:r1:1"
     KLLMS_FAILPOINTS="serving.request=disconnect:1"
     KLLMS_FAILPOINTS="engine.pages=leak:2"
-where the first numeric arg is ``times`` for raise/sleep/oom/corrupt/disconnect
-specs, ``times[:delay]`` for hang, ``kill[:seed]`` for kill_samples/nan,
-``kill`` (pages to drop) for leak, and ``member[:times]`` for down/fail
-(replica sites are keyed by replica id).
+    KLLMS_FAILPOINTS="consensus.device=fallback:3"
+where the first numeric arg is ``times`` for
+raise/sleep/oom/corrupt/disconnect/fallback specs, ``times[:delay]`` for hang,
+``kill[:seed]`` for kill_samples/nan, ``kill`` (pages to drop) for leak, and
+``member[:times]`` for down/fail (replica sites are keyed by replica id).
 """
 
 from __future__ import annotations
@@ -118,6 +128,7 @@ SITES = (
     "replica.dispatch",
     "replica.probe",
     "serving.request",
+    "consensus.device",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the
@@ -139,7 +150,7 @@ def _injected_oom() -> BaseException:
 @dataclass
 class FailSpec:
     # "raise" | "oom" | "sleep" | "hang" | "kill_samples" | "nan" | "corrupt"
-    # | "down" | "fail" | "disconnect"
+    # | "down" | "fail" | "disconnect" | "leak" | "fallback"
     action: str = "raise"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
@@ -164,6 +175,7 @@ class FailSpec:
             "fail",
             "disconnect",
             "leak",
+            "fallback",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -293,7 +305,7 @@ def configure_from_env(env: Optional[str] = None) -> None:
             times = int(args[0]) if args else 1
             delay = float(args[1]) if len(args) > 1 else HANG_DELAY
             specs[site] = FailSpec(action="hang", times=times, delay=delay)
-        elif action in ("oom", "corrupt", "disconnect"):
+        elif action in ("oom", "corrupt", "disconnect", "fallback"):
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action=action, times=times)
         elif action in ("down", "fail"):

@@ -63,6 +63,7 @@ _COUNTER_GROUPS = (
     ("quarantine", "QUARANTINE_EVENTS"),
     ("serve", "SERVE_EVENTS"),
     ("stream", "STREAM_EVENTS"),
+    ("consensus", "CONSENSUS_EVENTS"),
 )
 
 
@@ -157,7 +158,8 @@ class ServingApp:
         # HBM + paged-KV pool gauges from the backend's health snapshot (the
         # read doubles as a page-accounting invariant check).
         if backend is not None and hasattr(backend, "health"):
-            hbm = backend.health().get("hbm") or {}
+            health = backend.health()
+            hbm = health.get("hbm") or {}
             for key, val in sorted(hbm.items()):
                 if key == "page_pool" and isinstance(val, dict):
                     for pk, pv in sorted(val.items()):
@@ -166,6 +168,15 @@ class ServingApp:
                     lines.append(f"kllms_hbm_{key} {int(val)}")
                 elif isinstance(val, (int, float)) and val is not None:
                     lines.append(f"kllms_hbm_{key} {val}")
+            # Consensus cache gauges from the same snapshot: aggregate
+            # hits/misses/entries/evictions across every scorer's caches.
+            consensus = health.get("consensus") or {}
+            for key, val in sorted((consensus.get("cache") or {}).items()):
+                lines.append(f"kllms_consensus_cache_{key} {val}")
+            if "device_consensus" in consensus:
+                lines.append(
+                    f"kllms_consensus_device_enabled {int(bool(consensus['device_consensus']))}"
+                )
         body = ("\n".join(lines) + "\n").encode()
         _obs.SERVE_EVENTS.record("request.metrics.200")
         await _send_bytes(send, 200, body, content_type=b"text/plain; version=0.0.4")

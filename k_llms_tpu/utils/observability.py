@@ -135,6 +135,17 @@ QUARANTINE_EVENTS = EventCounters()
 #: app in ``serving/app.py`` and surfaced verbatim on ``/metrics``.
 SERVE_EVENTS = EventCounters()
 
+#: Process-wide on-device consensus counters (consensus.device_dispatch /
+#: consensus.host_dispatch — which path a consolidation's similarity prep
+#: took; consensus.fallback_failpoint / consensus.fallback_error /
+#: consensus.fallback_unavailable — why a device prepare degraded to host;
+#: consensus.device_busy — pair batches routed to the host Levenshtein because
+#: the chip lock was held; consensus.device_pairs / consensus.host_pairs /
+#: consensus.cached_pairs — where pair similarities came from;
+#: consensus.device_votes — vote columns tallied in the batched kernel), fed
+#: by consensus/device.py and surfaced via scheduler health and ``/metrics``.
+CONSENSUS_EVENTS = EventCounters()
+
 #: Process-wide SSE-streaming counters (streams.opened, streams.completed,
 #: streams.aborted — closed before the final consensus event, whether by
 #: client disconnect or a mid-stream error — and tokens.streamed, the count

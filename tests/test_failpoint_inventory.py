@@ -35,8 +35,9 @@ def test_every_registered_failpoint_is_exercised():
 def test_inventory_is_nonempty_and_names_are_registered():
     """Guard the guard: SITES is the single source of truth and stays
     dot-namespaced (subsystem.site), so grep hits are unambiguous."""
-    assert len(SITES) >= 10
+    assert len(SITES) >= 12
     assert "replica.dispatch" in SITES and "replica.probe" in SITES
+    assert "consensus.device" in SITES
     for site in SITES:
         sub, _, name = site.partition(".")
         assert sub and name, f"site {site!r} must be subsystem.name"
