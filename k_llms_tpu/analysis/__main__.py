@@ -1,0 +1,110 @@
+"""``python -m k_llms_tpu.analysis`` — run the kllms-check lint suite.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage
+error. ``--check`` is the CI entry point (quiet on success); the default mode
+prints every finding, suppressed ones included with their reasons.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .framework import (
+    RULES,
+    _ensure_rules_loaded,
+    load_project,
+    run_rules,
+    unsuppressed,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m k_llms_tpu.analysis",
+        description="kllms-check: project lint enforcing the serving stack's invariants",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        type=Path,
+        help="files or directories to lint (default: the configured package)",
+    )
+    parser.add_argument(
+        "--root",
+        type=Path,
+        default=None,
+        help="repo root holding pyproject.toml (default: auto-detect from this package)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="ID",
+        help="run only this rule id (repeatable)",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="CI mode: print only unsuppressed findings, exit 1 if any",
+    )
+    parser.add_argument("--json", action="store_true", help="JSON output")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list registered rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        # .../k_llms_tpu/analysis/__main__.py -> repo root two levels above
+        # the package directory.
+        root = Path(__file__).resolve().parent.parent.parent
+    if not Path(root).is_dir():
+        parser.error(f"--root {root} is not a directory")
+
+    if args.list_rules:
+        _ensure_rules_loaded()
+        for rid in sorted(RULES):
+            rule = RULES[rid]()
+            print(f"{rid}: {rule.summary}")
+        return 0
+
+    try:
+        project = load_project(root, paths=args.paths or None)
+        findings = run_rules(project, rule_ids=args.rules)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    visible = unsuppressed(findings) if args.check else findings
+    failing = unsuppressed(findings)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "root": str(root),
+                    "files": len(project.files),
+                    "rules": args.rules or sorted(RULES),
+                    "findings": [f.as_dict() for f in visible],
+                    "ok": not failing,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in visible:
+            print(f.format())
+        tag = "unsuppressed " if not args.check else ""
+        print(
+            f"kllms-check: {len(failing)} {tag}finding(s) across "
+            f"{len(project.files)} file(s)"
+        )
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,358 @@
+"""Runtime lock-order sanitizer (TSan-lite for this package's threading).
+
+The serving stack's deadlock freedom rests on a global lock acquisition
+order that no single test can see. These factories make it observable:
+
+- ``make_lock/make_rlock/make_condition(name)`` return plain ``threading``
+  primitives when ``KLLMS_LOCKCHECK`` is unset — zero overhead, identical
+  semantics — and instrumented wrappers when it is ``1``. The env var is
+  read at *factory call time*, so a test can ``monkeypatch.setenv`` and every
+  lock constructed afterwards is checked (module-level locks created at
+  import time stay plain; they are leaves by design).
+- Each wrapper records per-thread acquisition stacks and folds every
+  "B acquired while A held" pair into one process-wide lock-order graph. A
+  cycle in that graph is a potential deadlock — two threads walking it from
+  different ends — and is recorded as a violation with the offending path
+  and the ``file:line`` that closed it.
+- ``note_device_dispatch()`` marks device-dispatch points (batch launches,
+  ``device_get`` syncs). Dispatching while holding any lock not created with
+  ``allow_dispatch=True`` is a violation: a decode step takes milliseconds
+  and serializes every waiter behind it. ``allow_dispatch`` exists because
+  two locks guard device state on purpose (the paged pool's atomic-swap
+  contract); the flag moves that decision to the lock's creation site where
+  the static ``dispatch-under-lock`` rule reads the same declaration.
+
+Violations are recorded, not raised, at the point of detection — raising in
+an arbitrary worker thread would wedge the very soak that is trying to
+surface the bug. Call :func:`assert_clean` at the end of a test/soak.
+
+Lock names are canonical ids shared with the static ``lock-order`` rule
+(``engine.scheduler``, ``engine.kv_pool``...), so a runtime violation and a
+lint finding point at the same lock.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "LockCheckError",
+    "assert_clean",
+    "graph",
+    "lockcheck_enabled",
+    "make_condition",
+    "make_lock",
+    "make_rlock",
+    "note_device_dispatch",
+    "reset_state",
+    "violations",
+]
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def lockcheck_enabled() -> bool:
+    return os.getenv("KLLMS_LOCKCHECK", "").strip().lower() in _TRUE
+
+
+class LockCheckError(AssertionError):
+    """Raised by :func:`assert_clean` when any violation was recorded."""
+
+
+# Process-wide state. ``_state_lock`` is a plain threading.Lock on purpose —
+# instrumenting the sanitizer's own lock would recurse. Leaf: held only for
+# dict/list mutation in this module.
+# kllms: ignore[lock-order] — the sanitizer cannot instrument itself
+_state_lock = threading.Lock()
+_graph: Dict[Tuple[str, str], str] = {}  # (held, acquired) -> first site
+_violations: List[str] = []
+_violation_keys: set = set()
+_tls = threading.local()
+
+
+@dataclass
+class _HeldEntry:
+    lock: "_CheckedBase"
+    name: str
+    count: int
+
+
+def _held() -> List[_HeldEntry]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = []
+        _tls.held = held
+    return held
+
+
+_THIS_FILE = __file__
+
+
+def _caller() -> str:
+    # Nearest stack frame outside this module; only runs on first-edge
+    # creation and on violations, never on the steady-state acquire path.
+    for frame in reversed(traceback.extract_stack(limit=16)):
+        if frame.filename != _THIS_FILE:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _record_violation(msg: str) -> None:
+    with _state_lock:
+        if msg not in _violation_keys:
+            _violation_keys.add(msg)
+            _violations.append(msg)
+
+
+def _path_locked(src: str, dst: str) -> Optional[List[str]]:
+    """BFS path src -> dst over the edge relation; _state_lock must be held."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in _graph:
+        adj.setdefault(a, []).append(b)
+    frontier: List[List[str]] = [[src]]
+    seen = {src}
+    while frontier:
+        path = frontier.pop(0)
+        if path[-1] == dst:
+            return path
+        for nxt in adj.get(path[-1], ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(path + [nxt])
+    return None
+
+
+def _note_acquired(lock: "_CheckedBase") -> None:
+    held = _held()
+    for e in held:
+        if e.lock is lock:
+            if lock.kind == "lock":
+                _record_violation(
+                    f"non-reentrant lock {lock.name!r} re-acquired by the "
+                    f"same thread at {_caller()}"
+                )
+            e.count += 1
+            return
+    site: Optional[str] = None
+    with _state_lock:
+        for e in held:
+            if e.name == lock.name:
+                # distinct instances sharing a canonical name (per-member
+                # locks): no global order exists between them, skip the edge
+                continue
+            edge = (e.name, lock.name)
+            if edge in _graph:
+                continue
+            if site is None:
+                site = _caller()
+            _graph[edge] = site
+            back = _path_locked(lock.name, e.name)
+            if back is not None:
+                # back runs lock.name..e.name; prefixing e.name closes the walk
+                cycle = [e.name] + back
+                _violations_append_locked(
+                    "lock-order cycle: "
+                    + " -> ".join(cycle)
+                    + f" (edge {e.name}->{lock.name} closed at {site})"
+                )
+    held.append(_HeldEntry(lock=lock, name=lock.name, count=1))
+
+
+def _violations_append_locked(msg: str) -> None:
+    if msg not in _violation_keys:
+        _violation_keys.add(msg)
+        _violations.append(msg)
+
+
+def _note_released(lock: "_CheckedBase") -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        e = held[i]
+        if e.lock is lock:
+            e.count -= 1
+            if e.count <= 0:
+                del held[i]
+            return
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+
+class _CheckedBase:
+    kind = "lock"
+
+    def __init__(self, inner: Any, name: str, allow_dispatch: bool) -> None:
+        self._inner = inner
+        self.name = name
+        self.allow_dispatch = allow_dispatch
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_released(self)
+
+    def __enter__(self) -> "_CheckedBase":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.release()
+        return False
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._inner, item)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<checked {self.kind} {self.name!r}>"
+
+
+class _CheckedLock(_CheckedBase):
+    kind = "lock"
+
+    def __init__(self, name: str, allow_dispatch: bool) -> None:
+        super().__init__(threading.Lock(), name, allow_dispatch)
+
+
+class _CheckedRLock(_CheckedBase):
+    kind = "rlock"
+
+    def __init__(self, name: str, allow_dispatch: bool) -> None:
+        super().__init__(threading.RLock(), name, allow_dispatch)
+
+
+class _CheckedCondition(_CheckedBase):
+    """Condition wrapper. ``wait`` fully releases the underlying lock (that
+    is Condition's contract even under reentrancy), so the held entry is
+    popped for the duration and re-pushed on wake — otherwise the sanitizer
+    would see phantom "held across wait" orderings."""
+
+    kind = "condition"
+
+    def __init__(
+        self, name: str, allow_dispatch: bool, lock: Optional[Any] = None
+    ) -> None:
+        inner_lock = lock._inner if isinstance(lock, _CheckedBase) else lock
+        super().__init__(threading.Condition(inner_lock), name, allow_dispatch)
+
+    def _pop_for_wait(self) -> Optional[_HeldEntry]:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is self:
+                return held.pop(i)
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        entry = self._pop_for_wait()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            if entry is not None:
+                _held().append(entry)
+
+    def wait_for(
+        self, predicate: Callable[[], Any], timeout: Optional[float] = None
+    ) -> Any:
+        # Mirrors threading.Condition.wait_for, routed through our wait()
+        # so the held-stack bookkeeping stays correct.
+        endtime: Optional[float] = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+
+# ---------------------------------------------------------------------------
+# factories + dispatch marker + reporting
+# ---------------------------------------------------------------------------
+
+
+def make_lock(
+    name: str, *, allow_dispatch: bool = False
+) -> Union[threading.Lock, _CheckedLock]:
+    """A ``threading.Lock`` (or its checked twin under KLLMS_LOCKCHECK=1).
+    ``name`` is the canonical id shared with the static lock-order rule."""
+    if not lockcheck_enabled():
+        return threading.Lock()
+    return _CheckedLock(name, allow_dispatch)
+
+
+def make_rlock(
+    name: str, *, allow_dispatch: bool = False
+) -> Union[threading.RLock, _CheckedRLock]:
+    if not lockcheck_enabled():
+        return threading.RLock()
+    return _CheckedRLock(name, allow_dispatch)
+
+
+def make_condition(
+    name: str, lock: Optional[Any] = None, *, allow_dispatch: bool = False
+) -> Union[threading.Condition, _CheckedCondition]:
+    if not lockcheck_enabled():
+        inner = lock._inner if isinstance(lock, _CheckedBase) else lock
+        return threading.Condition(inner)
+    return _CheckedCondition(name, allow_dispatch, lock)
+
+
+def note_device_dispatch(what: str = "device dispatch") -> None:
+    """Mark a device-dispatch point. A violation is recorded for every held
+    checked lock not created with ``allow_dispatch=True``. Near-free when the
+    sanitizer is off: the calling thread holds no checked locks."""
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for e in held:
+        if not e.lock.allow_dispatch:
+            _record_violation(
+                f"{what} while holding {e.name!r} (created without "
+                f"allow_dispatch=True) at {_caller()}"
+            )
+
+
+def violations() -> List[str]:
+    with _state_lock:
+        return list(_violations)
+
+
+def graph() -> Dict[Tuple[str, str], str]:
+    """The observed lock-order edges: (held, acquired) -> first site."""
+    with _state_lock:
+        return dict(_graph)
+
+
+def reset_state() -> None:
+    """Clear the global graph and violation log (test isolation). Held-lock
+    stacks are thread-local and owned by live threads; they are not touched."""
+    with _state_lock:
+        _graph.clear()
+        _violations.clear()
+        _violation_keys.clear()
+
+
+def assert_clean() -> None:
+    """Raise :class:`LockCheckError` listing every recorded violation."""
+    found = violations()
+    if found:
+        raise LockCheckError(
+            f"{len(found)} lockcheck violation(s):\n" + "\n".join(found)
+        )

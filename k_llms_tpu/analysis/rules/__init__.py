@@ -1,0 +1,5 @@
+"""kllms-check rule modules. Importing this package registers every rule
+with :data:`k_llms_tpu.analysis.framework.RULES` via the ``@register``
+decorators — the framework imports it lazily from ``_ensure_rules_loaded``."""
+
+from . import contracts, hotpath, locks  # noqa: F401

@@ -1,0 +1,218 @@
+"""Device-performance rules: host syncs in hot paths, jit compile-cache abuse.
+
+- **host-sync-hot-path** — ``.item()``, ``.tolist()``, ``np.asarray``,
+  ``jax.device_get``, ``block_until_ready`` force a device→host transfer and
+  a pipeline stall. Inside a function that becomes a jitted body they are a
+  tracing bug; inside a configured hot function (the per-token decode step,
+  see ``[tool.kllms-check.host-sync-hot-path] hot_functions``) each one is a
+  per-token sync that caps throughput. The continuous loop's single
+  by-design sync per step carries an inline suppression explaining why.
+- **jit-recompile-hygiene** — ``jax.jit(...)`` compiles on first call per
+  wrapper object. A wrapper created inside a per-request function is a new
+  object every call, so XLA recompiles every request. Sanctioned patterns
+  are the ones this repo uses deliberately: memoized stores
+  (``self._x = jax.jit(f)``, ``cache[key] = jax.jit(f)``), module-level
+  wrappers, ``functools.lru_cache``-decorated factories, and builder
+  functions (``__init__``, ``_build*``, ``make_*``...).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Iterable, List, Set
+
+from ..framework import Finding, Project, ProjectFile, Rule, register
+from ._astutil import decorator_names, dotted, functions_in, walk_same_scope
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SYNC_DOTTED = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+}
+_JIT_NAMES = {"jax.jit", "jit"}
+_MEMO_DECORATORS = {
+    "functools.lru_cache",
+    "lru_cache",
+    "functools.cache",
+    "cache",
+}
+_DEFAULT_BUILDERS = [
+    "__init__",
+    "_build*",
+    "build_*",
+    "_make*",
+    "make_*",
+    "*_factory",
+]
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d in _JIT_NAMES:
+        return True
+    # functools.partial(jax.jit, static_argnums=...) applied later
+    if d in ("functools.partial", "partial") and call.args:
+        return dotted(call.args[0]) in _JIT_NAMES
+    return False
+
+
+def _sync_call_name(call: ast.Call) -> str:
+    """Non-empty description when the call is a host sync."""
+    d = dotted(call.func)
+    if d is not None and d in _SYNC_DOTTED:
+        return d
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _SYNC_METHODS:
+        # Zero-arg attribute calls only: x.item(), arr.block_until_ready().
+        # dict.item/tolist false positives don't exist (those take no such
+        # names); map(np.asarray, ...) is caught via the Name reference below.
+        if not call.args and not call.keywords:
+            return f"*.{call.func.attr}"
+    return ""
+
+
+def _jitted_function_names(pf: ProjectFile) -> Set[str]:
+    """Names of local functions handed to jax.jit anywhere in the file."""
+    out: Set[str] = set()
+    if pf.tree is None:
+        return out
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+@register
+class HostSyncHotPathRule(Rule):
+    id = "host-sync-hot-path"
+    summary = "no host↔device syncs inside jitted bodies or decode-step functions"
+    invariant = (
+        ".item()/.tolist()/np.asarray/jax.device_get/block_until_ready do "
+        "not appear inside functions that become jitted bodies or inside "
+        "configured hot functions (per-token decode steps) — each one is a "
+        "full pipeline stall"
+    )
+    subsystem = "engine/, models/, ops/, consensus/device.py"
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        hot_patterns = [
+            str(p)
+            for p in project.rule_config(self.id).get("hot_functions", [])
+        ]
+        for pf in project.files:
+            if pf.tree is None:
+                continue
+            jitted = _jitted_function_names(pf)
+            for fn in functions_in(pf.tree):
+                if fn.name in jitted or any(
+                    d in _JIT_NAMES for d in decorator_names(fn.node)
+                ):
+                    context = "a jitted body"
+                elif any(fnmatch.fnmatch(fn.name, p) for p in hot_patterns):
+                    context = "a configured hot function"
+                else:
+                    continue
+                for node in walk_same_scope(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    syncs = []
+                    direct = _sync_call_name(node)
+                    if direct:
+                        syncs.append(direct)
+                    for arg in node.args:
+                        # callables handed to map()/comprehension helpers:
+                        # map(np.asarray, arrays) syncs just the same
+                        d = dotted(arg)
+                        if d in _SYNC_DOTTED:
+                            syncs.append(d)
+                    for sync in syncs:
+                        yield Finding(
+                            self.id,
+                            pf.rel,
+                            node.lineno,
+                            f"host sync {sync} inside {context} "
+                            f"({fn.qualname}) — forces a device→host round "
+                            "trip per invocation",
+                        )
+
+
+@register
+class JitRecompileRule(Rule):
+    id = "jit-recompile-hygiene"
+    summary = "jax.jit wrappers are created once, not per request"
+    invariant = (
+        "jax.jit(...) results are stored in memoized slots (self attribute, "
+        "cache subscript, module global) or created inside builder/"
+        "lru_cache factories — a wrapper built inside a per-request function "
+        "recompiles on every call"
+    )
+    subsystem = "engine/, models/, ops/, consensus/device.py"
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        builders = _DEFAULT_BUILDERS + [
+            str(p)
+            for p in project.rule_config(self.id).get("builder_functions", [])
+        ]
+        for pf in project.files:
+            if pf.tree is None:
+                continue
+            # Module-level jit wrappers compile once per import; only code
+            # inside functions can recompile per call, so only that is walked.
+            for fn in functions_in(pf.tree):
+                if any(fnmatch.fnmatch(fn.name, p) for p in builders):
+                    continue
+                if any(
+                    d in _MEMO_DECORATORS for d in decorator_names(fn.node)
+                ):
+                    continue  # memoized factory: one wrapper per arg tuple
+                sanctioned: Set[int] = set()
+                jit_locals: dict = {}  # local name -> [jit call ids]
+                stored_names: Set[str] = set()
+                for node in walk_same_scope(fn.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    is_jit = isinstance(node.value, ast.Call) and _is_jit_call(
+                        node.value
+                    )
+                    if is_jit and all(
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in node.targets
+                    ):
+                        # self._fn = jit(...) / cache[key] = jit(...):
+                        # the store is the memoization.
+                        sanctioned.add(id(node.value))
+                    elif is_jit:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                jit_locals.setdefault(t.id, []).append(
+                                    id(node.value)
+                                )
+                    elif isinstance(node.value, ast.Name) and any(
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in node.targets
+                    ):
+                        # cache[key] = fn — the memoized-getter idiom where
+                        # the wrapper is built in a local first.
+                        stored_names.add(node.value.id)
+                for name in stored_names:
+                    sanctioned.update(jit_locals.get(name, ()))
+                for node in walk_same_scope(fn.node):
+                    if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+                        continue
+                    if id(node) in sanctioned:
+                        continue
+                    yield Finding(
+                        self.id,
+                        pf.rel,
+                        node.lineno,
+                        f"jax.jit(...) inside {fn.qualname} is neither stored "
+                        "in a memoized slot (self attribute / cache "
+                        "subscript) nor inside a builder or lru_cache "
+                        "factory — this recompiles on every call",
+                    )

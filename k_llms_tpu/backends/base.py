@@ -7,6 +7,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Union
 
+from ..analysis.lockcheck import make_lock
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..reliability.retry import CircuitBreaker, RetryPolicy
@@ -199,7 +200,7 @@ class Backend(abc.ABC):
     # registry itself lives per-instance so caches follow the engine (and die
     # with it), like the reference's module-global TTL caches follow the process
     # (`consensus_utils.py:620-623`).
-    _scorer_registry_lock = threading.Lock()
+    _scorer_registry_lock = make_lock("backends.scorer_registry")
 
     def similarity_scorer(self, method: str) -> "SimilarityScorer":
         """The shared per-method similarity scorer for this backend. Every

@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict
-from threading import Lock
 from typing import Any, Dict, Hashable, Optional
+
+from ..analysis.lockcheck import make_lock
 
 
 class TTLCache:
@@ -29,7 +30,9 @@ class TTLCache:
         self.ttl = ttl
         self.name = name
         self._data: OrderedDict[Hashable, tuple[float, Any]] = OrderedDict()
-        self._lock = Lock()
+        self._lock = make_lock(
+            f"consensus.cache.{name}" if name else "consensus.cache"
+        )
         self._hits = 0
         self._misses = 0
         self._evictions = 0

@@ -50,6 +50,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.lockcheck import make_lock, note_device_dispatch
 from ..native import levenshtein_distance
 from ..reliability import failpoints as _failpoints
 from ..utils.observability import CONSENSUS_EVENTS
@@ -89,6 +90,9 @@ class DeviceConsensusUnavailable(RuntimeError):
 
 
 _jax_state: Optional[Tuple[bool, Any]] = None
+# Import-time module lock (created before any KLLMS_LOCKCHECK opt-in can take
+# effect) guarding one lazy probe; leaf by design.
+# kllms: ignore[lock-order] — import-time module lock, leaf by design
 _jax_state_lock = threading.Lock()
 
 
@@ -461,7 +465,9 @@ class DeviceSimilarityScorer(SimilarityScorer):
         # bucket, value = the scored pair map. Warm repeats skip the device.
         self._bucket_cache = TTLCache(maxsize=4096, ttl=300.0, name="pairs")
         self._tls = threading.local()
-        self._device_lock = threading.Lock()  # chip-busy gate (non-blocking)
+        # Chip-busy gate: taken non-blocking, and held across the batched
+        # similarity kernel dispatch on purpose — that hold IS the gate.
+        self._device_lock = make_lock("consensus.device_chip", allow_dispatch=True)
         self.cache_enabled = True  # bench toggle (cache on/off axis)
 
     # -- consolidation hooks ----------------------------------------------
@@ -597,6 +603,7 @@ class DeviceSimilarityScorer(SimilarityScorer):
         (another thread mid-kernel) so consolidations never queue on it."""
         if self._device_lock.acquire(blocking=False):
             try:
+                note_device_dispatch("consensus pair kernel")
                 dists = batched_levenshtein(pairs)
                 CONSENSUS_EVENTS.record("consensus.device_pairs", len(pairs))
                 return dists
@@ -647,6 +654,7 @@ class DeviceSimilarityScorer(SimilarityScorer):
             CONSENSUS_EVENTS.record("consensus.device_busy")
             return
         try:
+            note_device_dispatch("consensus vote kernel")
             results = batched_votes(jobs)
         finally:
             self._device_lock.release()

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from concurrent.futures import Future
 
+from ..analysis.lockcheck import make_condition, note_device_dispatch
 from ..models.llama import KVCache, init_cache, paged_verify_step, verify_step
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
@@ -109,7 +110,10 @@ class ContinuousDecodeLoop:
         self.max_new = int(max_new)
         self.eos_ids = list(eos_ids or [engine.config.eos_token_id])
         self._admission_gate = admission_gate
-        self._lock = threading.Condition()
+        # The loop Condition is held across admission prefill and the step
+        # dispatch on purpose: one decode thread owns the device, and slot
+        # state must mutate atomically with the arrays it indexes.
+        self._lock = make_condition("engine.continuous", allow_dispatch=True)
         self._queue: "deque[_SlotRequest]" = deque()
         self._pending_prefill: Dict[int, Any] = {}
         self._active: List[Optional[_SlotRequest]] = [None] * self.width
@@ -729,6 +733,7 @@ class ContinuousDecodeLoop:
         if self.paged:
             pool = self._pool
             with pool.lock:
+                note_device_dispatch("continuous paged step")
                 tok, lp, new_k, new_v = self._step_paged_fn(
                     self.engine.params, pool.kv.k, pool.kv.v, cur, gen_lens,
                     prompt_lens, active, seeds, sidx, temps, tps, pidx, gidx,
@@ -736,10 +741,14 @@ class ContinuousDecodeLoop:
                 )
                 pool.kv = KVCache(k=new_k, v=new_v)
         else:
+            note_device_dispatch("continuous dense step")
             tok, lp, self._gen = self._step_fn(
                 self.engine.params, self._prefix, self._gen, cur, gen_lens,
                 prompt_lens, active, seeds, sidx, temps, tps,
             )
+        # The one by-design sync per step: slot bookkeeping below needs the
+        # sampled token ids on the host, and it runs outside both locks.
+        # kllms: ignore[host-sync-hot-path] — the per-step result readback; everything after it is host-side bookkeeping
         tok_np, lp_np = map(np.asarray, jax.device_get((tok, lp)))
         with self._lock:
             self._stats["steps"] += 1

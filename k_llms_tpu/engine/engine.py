@@ -33,6 +33,7 @@ from jax.experimental import io_callback
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..analysis.lockcheck import make_rlock, note_device_dispatch
 from ..models.config import ModelConfig, get_config
 from ..models.llama import (
     KVCache,
@@ -436,7 +437,9 @@ class LocalEngine:
         # Serializes paged cache-entry/allocator mutation between the
         # continuous-loop worker and scheduler threads (dense entries are
         # immutable arrays and never needed this; page refcounts do).
-        self._paged_mutex = threading.RLock()
+        # allow_dispatch: paged admission prefills under this mutex so page
+        # reservation and the KV writes they cover commit atomically.
+        self._paged_mutex = make_rlock("engine.paged_mutex", allow_dispatch=True)
 
         # Speculative decoding: "prompt_lookup" drafts the next spec_lookahead
         # tokens from the prompt's own text and verifies them in one forward
@@ -2428,6 +2431,7 @@ class LocalEngine:
         it to just that member's caller; the rest of the batch is unaffected.
         """
         _failpoints.fire("engine.launch")
+        note_device_dispatch("engine batched launch")
         if not items:
             return []
         if len(items) == 1:

@@ -46,10 +46,11 @@ launch is already a numeric-quarantine event on the dense path too.
 from __future__ import annotations
 
 import math
-import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..analysis.lockcheck import make_rlock, note_device_dispatch
 
 #: Page id 0 is the TRASH page: never allocated, never in a block table.
 #: Masked gather slots and inactive-row writes point into it, so every flat
@@ -83,7 +84,7 @@ class PageAllocator:
             raise ValueError("page_size must be >= 1")
         self.total_pages = int(total_pages)
         self.page_size = int(page_size)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("engine.page_allocator")
         # LIFO free stack: recently freed pages are re-used first (their HBM
         # is warm and their contents are already overwritten by the next
         # owner's scatter before any unmasked read).
@@ -276,7 +277,9 @@ class PagedKVPool:
         self.config = config
         self.page_size = int(page_size)
         self.allocator = PageAllocator(total_pages, page_size)
-        self.lock = threading.RLock()
+        # Held across the jitted scatter/gather/copy dispatch on purpose:
+        # self.kv swaps atomically with the donated buffers it replaces.
+        self.lock = make_rlock("engine.kv_pool", allow_dispatch=True)
         flat = int(total_pages) * int(page_size)
         shape = (config.num_layers, flat, config.num_kv_heads, config.head_dim)
         dtype = dtype or config.jax_dtype
@@ -354,6 +357,7 @@ class PagedKVPool:
 
         idx = jnp.asarray(np.asarray(slot_idx, np.int32))
         with self.lock:
+            note_device_dispatch("paged kv scatter")
             self.kv = self._scatter_fn(int(idx.shape[0]))(
                 self.kv.k, self.kv.v, k_src, v_src, idx
             )
@@ -364,6 +368,7 @@ class PagedKVPool:
 
         idx = jnp.asarray(np.asarray(slot_idx, np.int32))
         with self.lock:
+            note_device_dispatch("paged kv gather")
             return self._gather_fn(int(idx.shape[0]))(self.kv.k, self.kv.v, idx)
 
     def copy_pages(self, src_pages: Sequence[int], dst_pages: Sequence[int]) -> None:
@@ -382,6 +387,7 @@ class PagedKVPool:
             [np.arange(p * ps, (p + 1) * ps, dtype=np.int32) for p in dst_pages]
         )
         with self.lock:
+            note_device_dispatch("paged kv page copy")
             self.kv = self._copy_fn(int(src.shape[0]))(
                 self.kv.k, self.kv.v, jnp.asarray(src), jnp.asarray(dst)
             )

@@ -49,6 +49,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..analysis.lockcheck import make_condition
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, RateLimitError, ServerDrainingError
@@ -155,7 +156,7 @@ class EngineScheduler:
         max_queue_weight: Optional[int] = None,
     ):
         self._items: "deque[Optional[_Item]]" = deque()
-        self._cv = threading.Condition()
+        self._cv = make_condition("engine.scheduler")
         self._served = 0
         self._errors = 0
         self._batches = 0

@@ -182,6 +182,10 @@ class FailSpec:
             self.delay = HANG_DELAY
 
 
+# Import-time module lock: this module configures itself from the env at
+# import, before any KLLMS_LOCKCHECK opt-in. Leaf by design — registry
+# mutation only, never nested with another lock.
+# kllms: ignore[lock-order] — import-time module lock, leaf by design
 _lock = threading.Lock()
 _registry: Dict[str, FailSpec] = {}
 

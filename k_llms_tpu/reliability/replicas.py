@@ -52,6 +52,7 @@ from collections import deque
 from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+from ..analysis.lockcheck import make_lock
 from ..backends.base import Backend, ChatRequest
 from ..types import ChatCompletion
 from ..types.wire import (
@@ -90,7 +91,7 @@ class ReplicaHandle:
     def __init__(self, replica_id: str, backend: Backend):
         self.replica_id = replica_id
         self.backend = backend
-        self.lock = threading.Lock()
+        self.lock = make_lock(f"reliability.replica.{replica_id}")
         self._ewma_s: Optional[float] = None
         self._recent: "deque[float]" = deque(maxlen=64)
         self.dispatched = 0
@@ -239,7 +240,7 @@ class ReplicaSet(Backend):
         self.model_name = (
             model or getattr(handles[0].backend, "model_name", None) or "replicas"
         )
-        self._rr_lock = threading.Lock()
+        self._rr_lock = make_lock("reliability.replica_rr")
         self._rr_next = 0
         self._closed = False
         # Sized for hedged dispatch (2 workers per in-flight hedged request)

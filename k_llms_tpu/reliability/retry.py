@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Tuple, Type, TypeVar
 
+from ..analysis.lockcheck import make_lock
 from ..types.wire import BackendUnavailableError, KLLMsError
 from ..utils.observability import FAILURE_EVENTS
 from .deadline import RequestBudget
@@ -130,7 +131,7 @@ class CircuitBreaker:
         self.reset_timeout = reset_timeout
         self.name = name
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"reliability.breaker.{name}" if name else "reliability.breaker")
         self._failures = 0
         self._state = "closed"  # closed | open | half_open
         self._opened_at = 0.0

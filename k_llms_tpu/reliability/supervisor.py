@@ -38,6 +38,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Deque, Dict, Optional, Tuple
 
+from ..analysis.lockcheck import make_lock
 from ..types.wire import CheckpointCorruptError, EngineHungError
 from ..utils.observability import RECOVERY_EVENTS
 
@@ -71,7 +72,7 @@ class LaunchBudgetModel:
         self.min_budget_s = min_budget_s
         self.max_budget_s = max_budget_s
         self.ewma_alpha = ewma_alpha
-        self._lock = threading.Lock()
+        self._lock = make_lock("reliability.launch_budget")
         self._per_token_s = per_token_s
         self._observed = 0
 
@@ -127,7 +128,7 @@ class EngineSupervisor:
         self.on_recovering = on_recovering
         self.on_rebuilt = on_rebuilt
         self.on_rebuild_failed = on_rebuild_failed
-        self._lock = threading.Lock()
+        self._lock = make_lock("reliability.supervisor")
         self._epoch = 0
         self._consecutive_rebuilds = 0
         self._total_rebuilds = 0

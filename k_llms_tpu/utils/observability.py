@@ -10,11 +10,13 @@ traces (Perfetto-compatible dumps), and consensus-confidence histograms.
 from __future__ import annotations
 
 import contextlib
+import fnmatch
 import logging
 import os
-import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..analysis.lockcheck import make_lock
 
 
 def configure_logging() -> logging.Logger:
@@ -65,13 +67,36 @@ class EventCounters:
     """Thread-safe named counters for failure-path events (retries, circuit
     trips, deadline sheds, decode aborts, failpoint kills). Cheap enough to
     record from the scheduler worker and dispatch paths; snapshot from tests
-    or a stats endpoint."""
+    or a stats endpoint.
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
+    ``declared`` is the group's counter vocabulary: literal names plus
+    fnmatch wildcards for keyed families (``request.*``). Recording a name
+    outside it raises — a typo'd counter that silently lands in its own
+    bucket is invisible on every dashboard that queries the real name. The
+    ``counter-hygiene`` lint statically checks every record() literal against
+    the same patterns, so the declaration is enforced both ways."""
+
+    def __init__(self, declared: Optional[Sequence[str]] = None) -> None:
+        self._lock = make_lock("observability.counters")
         self._counts: Dict[str, int] = {}
+        self.declared: Tuple[str, ...] = tuple(declared or ())
+        self._exact = {
+            p for p in self.declared if "*" not in p and "?" not in p
+        }
+        self._globs = [p for p in self.declared if p not in self._exact]
+
+    def _check_declared(self, event: str) -> None:
+        if not self.declared or event in self._exact:
+            return
+        if any(fnmatch.fnmatch(event, p) for p in self._globs):
+            return
+        raise ValueError(
+            f"counter {event!r} is not declared for this group "
+            f"(declared: {sorted(self.declared)})"
+        )
 
     def record(self, event: str, n: int = 1) -> None:
+        self._check_declared(event)
         with self._lock:
             self._counts[event] = self._counts.get(event, 0) + n
 
@@ -91,49 +116,95 @@ class EventCounters:
 #: Process-wide failure-event counters shared by the reliability layer
 #: (retry attempts, circuit transitions), the scheduler (deadline sheds,
 #: cancellations), and the engine (decode aborts, killed samples).
-FAILURE_EVENTS = EventCounters()
+FAILURE_EVENTS = EventCounters(declared=(
+    "scheduler.shed",
+    "scheduler.shed_stopped",
+    "scheduler.shed_over_capacity",
+    "scheduler.shed_draining",
+    "engine.decode_abort",
+    "engine.samples_killed",
+    "engine.oom",
+    "engine.oom_unrecovered",
+    "engine.oom_split",
+    "retry.attempt",
+    "circuit.rejected",
+    "circuit.opened",
+    "consensus.zero_survivors",
+))
 
 #: Process-wide speculative-decoding counters (spec.launches, spec.drafted,
 #: spec.accepted), fed by EngineScheduler.note_spec_stats from the engine's
 #: per-launch on_spec_stats hook. spec.accepted / spec.drafted is the
 #: fleet-level acceptance rate operators tune spec_lookahead against.
-SPEC_EVENTS = EventCounters()
+SPEC_EVENTS = EventCounters(declared=(
+    "spec.launches",
+    "spec.drafted",
+    "spec.accepted",
+))
 
 #: Process-wide self-healing counters (supervisor.hung_launches,
 #: supervisor.rebuilds, supervisor.rebuild_failures, supervisor.replayed,
 #: supervisor.stale_results_discarded), fed by the EngineSupervisor. A nonzero
 #: rebuild count on a healthy fleet is the "devices are flaking" alarm.
-RECOVERY_EVENTS = EventCounters()
+RECOVERY_EVENTS = EventCounters(declared=(
+    "supervisor.hung_launches",
+    "supervisor.rebuilds",
+    "supervisor.rebuild_failures",
+    "supervisor.replayed",
+    "supervisor.stale_results_discarded",
+))
 
 #: Process-wide replica-routing counters (route.dispatched, route.pulled —
 #: members removed from rotation, route.probes / route.probe_failures /
 #: route.rejoins — probation lifecycle, route.no_healthy — requests that found
 #: zero eligible members), fed by the ReplicaSet router.
-ROUTE_EVENTS = EventCounters()
+ROUTE_EVENTS = EventCounters(declared=(
+    "route.dispatched",
+    "route.pulled",
+    "route.probes",
+    "route.probe_failures",
+    "route.rejoins",
+    "route.no_healthy",
+))
 
 #: Process-wide hedged-dispatch counters (hedge.launched, hedge.won_primary,
 #: hedge.won_hedge, hedge.cancelled_losers). hedge.won_hedge / hedge.launched
 #: is the rescue rate: how often duplicating the tail actually paid off.
-HEDGE_EVENTS = EventCounters()
+HEDGE_EVENTS = EventCounters(declared=(
+    "hedge.launched",
+    "hedge.won_primary",
+    "hedge.won_hedge",
+    "hedge.cancelled_losers",
+))
 
 #: Process-wide mid-flight failover counters (failover.attempts,
 #: failover.member_down, failover.exhausted). Nonzero failover on a healthy
 #: fleet means a member is flapping faster than its probes rejoin it.
-FAILOVER_EVENTS = EventCounters()
+FAILOVER_EVENTS = EventCounters(declared=(
+    "failover.attempts",
+    "failover.member_down",
+    "failover.exhausted",
+))
 
 #: Process-wide numeric-integrity counters (quarantine.samples — decode rows
 #: quarantined for NaN/Inf/degenerate logits, quarantine.launches — launches
 #: with at least one poisoned row, quarantine.checksum_failures — corrupted
 #: checkpoints rejected at load). Poison on a healthy fleet means bad HBM or a
 #: bad checkpoint, not bad luck.
-QUARANTINE_EVENTS = EventCounters()
+QUARANTINE_EVENTS = EventCounters(declared=(
+    "quarantine.samples",
+    "quarantine.launches",
+    "quarantine.checksum_failures",
+))
 
 
 #: Process-wide HTTP-serving counters (request.<route>.<status> — one per
 #: completed request keyed by route and HTTP status, plus request.disconnect
 #: for clients that dropped before the response finished), fed by the ASGI
 #: app in ``serving/app.py`` and surfaced verbatim on ``/metrics``.
-SERVE_EVENTS = EventCounters()
+SERVE_EVENTS = EventCounters(declared=(
+    "request.*",  # request.<route>.<status> + request.disconnect, keyed per route
+))
 
 #: Process-wide on-device consensus counters (consensus.device_dispatch /
 #: consensus.host_dispatch — which path a consolidation's similarity prep
@@ -144,14 +215,30 @@ SERVE_EVENTS = EventCounters()
 #: consensus.cached_pairs — where pair similarities came from;
 #: consensus.device_votes — vote columns tallied in the batched kernel), fed
 #: by consensus/device.py and surfaced via scheduler health and ``/metrics``.
-CONSENSUS_EVENTS = EventCounters()
+CONSENSUS_EVENTS = EventCounters(declared=(
+    "consensus.device_dispatch",
+    "consensus.host_dispatch",
+    "consensus.fallback_failpoint",
+    "consensus.fallback_error",
+    "consensus.fallback_unavailable",
+    "consensus.device_busy",
+    "consensus.device_pairs",
+    "consensus.host_pairs",
+    "consensus.cached_pairs",
+    "consensus.device_votes",
+))
 
 #: Process-wide SSE-streaming counters (streams.opened, streams.completed,
 #: streams.aborted — closed before the final consensus event, whether by
 #: client disconnect or a mid-stream error — and tokens.streamed, the count
 #: of delta chunks put on the wire). streams.aborted / streams.opened is the
 #: stream-survival rate operators watch during deploys.
-STREAM_EVENTS = EventCounters()
+STREAM_EVENTS = EventCounters(declared=(
+    "streams.opened",
+    "streams.completed",
+    "streams.aborted",
+    "tokens.streamed",
+))
 
 
 def _walk_confidences(node: Any, out: List[float]) -> None:

@@ -1,0 +1,8 @@
+"""counter-hygiene fixture call sites: one covered record, one typo."""
+
+from .utils.observability import BETA_EVENTS
+
+
+def work():
+    BETA_EVENTS.record("a.b")
+    BETA_EVENTS.record("a.typo")  # not covered by declared= patterns

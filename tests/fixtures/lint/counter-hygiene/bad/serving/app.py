@@ -1,0 +1,7 @@
+"""counter-hygiene fixture metrics surface: one group missing on purpose."""
+
+from ..utils.observability import BETA_EVENTS
+
+
+def metrics():
+    return {"beta": BETA_EVENTS.declared}

@@ -1,0 +1,17 @@
+"""counter-hygiene fixture groups: one undeclared, one with a stale name."""
+
+
+class EventCounters:
+    def __init__(self, declared=None):
+        self.declared = tuple(declared or ())
+
+    def record(self, event, n=1):
+        pass
+
+
+ALPHA_EVENTS = EventCounters()  # no declared= vocabulary
+
+BETA_EVENTS = EventCounters(declared=(
+    "a.b",
+    "stale.name",  # declared but never recorded anywhere
+))

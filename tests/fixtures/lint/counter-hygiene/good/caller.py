@@ -1,0 +1,8 @@
+"""counter-hygiene fixture call sites: literals and an f-string family."""
+
+from .utils.observability import EVENTS
+
+
+def work(route):
+    EVENTS.record("a.b")
+    EVENTS.record(f"keyed.{route}")

@@ -1,0 +1,7 @@
+"""counter-hygiene fixture metrics surface: every group exported."""
+
+from ..utils.observability import EVENTS
+
+
+def metrics():
+    return {"events": EVENTS.declared}

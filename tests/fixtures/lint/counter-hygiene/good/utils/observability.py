@@ -1,0 +1,15 @@
+"""counter-hygiene fixture groups: declared vocabulary covers every record."""
+
+
+class EventCounters:
+    def __init__(self, declared=None):
+        self.declared = tuple(declared or ())
+
+    def record(self, event, n=1):
+        pass
+
+
+EVENTS = EventCounters(declared=(
+    "a.b",
+    "keyed.*",  # f-string family: keyed.<route>
+))

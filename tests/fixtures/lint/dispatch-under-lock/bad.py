@@ -1,0 +1,17 @@
+"""dispatch-under-lock fixture: device work under a plain lock."""
+
+import jax
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+G = make_lock("fix.guard")
+
+
+def run(step_fn, x):
+    with G:
+        return step_fn(x)
+
+
+def read(x):
+    with G:
+        return jax.device_get(x)

@@ -1,0 +1,20 @@
+"""dispatch-under-lock fixture: the hold is declared at the creation site,
+or the dispatch happens outside the critical section."""
+
+import jax
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+G = make_lock("fix.guard", allow_dispatch=True)
+H = make_lock("fix.other")
+
+
+def run(step_fn, x):
+    with G:
+        return step_fn(x)
+
+
+def read(step_fn, x):
+    with H:
+        y = x + 1
+    return jax.device_get(step_fn(y))

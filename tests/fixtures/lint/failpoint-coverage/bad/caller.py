@@ -1,0 +1,13 @@
+"""failpoint-coverage fixture call sites: a typo'd site and a dynamic one."""
+
+from .reliability import failpoints as _failpoints
+
+
+def _site_name():
+    return "engine." + "dynamic"
+
+
+def launch():
+    _failpoints.fire("engine.launch")
+    _failpoints.fire("engine.typo")  # not registered in SITES
+    _failpoints.fire(_site_name())  # non-literal: statically uncheckable

@@ -1,0 +1,12 @@
+"""failpoint-coverage fixture registry: one live site, one dead entry."""
+
+SITES = (
+    "engine.launch",
+    "engine.ghost",  # registered but never fired/tested/documented
+)
+
+
+class FailSpec:
+    def __post_init__(self):
+        if self.action not in ("error", "hang"):
+            raise ValueError(f"unknown failpoint action {self.action!r}")

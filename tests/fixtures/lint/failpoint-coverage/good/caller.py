@@ -1,0 +1,11 @@
+"""failpoint-coverage fixture call sites: every registered site fires."""
+
+from .reliability import failpoints as _failpoints
+
+
+def launch():
+    _failpoints.fire("engine.launch")
+
+
+def release_pages():
+    _failpoints.fire_keyed("engine.pages", key="slot0")

@@ -1,0 +1,12 @@
+"""failpoint-coverage fixture registry: every site live and documented."""
+
+SITES = (
+    "engine.launch",
+    "engine.pages",
+)
+
+
+class FailSpec:
+    def __post_init__(self):
+        if self.action not in ("error", "hang"):
+            raise ValueError(f"unknown failpoint action {self.action!r}")

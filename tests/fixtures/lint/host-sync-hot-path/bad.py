@@ -1,0 +1,19 @@
+"""host-sync-hot-path fixture: syncs inside a jitted body and a hot function.
+
+The test runs this with ``hot_functions = ["decode_step"]``.
+"""
+
+import jax
+import numpy as np
+
+
+def _kernel(x):
+    return x.item()  # sync inside a function that becomes a jitted body
+
+
+run = jax.jit(_kernel)
+
+
+def decode_step(arrays, tok):
+    host = list(map(np.asarray, arrays))  # sync callable handed to map()
+    return host, jax.device_get(tok)  # direct sync

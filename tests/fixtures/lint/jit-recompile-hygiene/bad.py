@@ -1,0 +1,8 @@
+"""jit-recompile-hygiene fixture: a wrapper built per call and thrown away."""
+
+import jax
+
+
+def per_request(f, x):
+    g = jax.jit(f)  # new wrapper object every call -> recompile every call
+    return g(x)

@@ -1,0 +1,34 @@
+"""jit-recompile-hygiene fixture: every sanctioned creation pattern."""
+
+import functools
+
+import jax
+
+
+def _double(x):
+    return x * 2
+
+
+STEP = jax.jit(_double)  # module level: compiled once per import
+
+
+def _build_step(f):
+    return jax.jit(f)  # builder-named function
+
+
+@functools.lru_cache(maxsize=8)
+def step_for(width):
+    return jax.jit(lambda x: x * width)  # memoized factory
+
+
+class Engine:
+    def __init__(self, f):
+        self._fn = jax.jit(f)  # memoized store in __init__
+        self._cache = {}
+
+    def get(self, key, f):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = jax.jit(f)
+            self._cache[key] = fn  # memoized-getter idiom: store then reuse
+        return fn

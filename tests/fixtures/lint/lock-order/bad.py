@@ -1,0 +1,22 @@
+"""lock-order fixture: an A->B / B->A cycle plus a raw threading lock."""
+
+import threading
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+RAW = threading.Lock()  # raw primitive: invisible to KLLMS_LOCKCHECK
+
+A = make_lock("fix.a")
+B = make_lock("fix.b")
+
+
+def forward():
+    with A:
+        with B:
+            return 1
+
+
+def backward():
+    with B:
+        with A:
+            return 2

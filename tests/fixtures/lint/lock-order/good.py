@@ -1,0 +1,18 @@
+"""lock-order fixture: both call sites agree on A-before-B; factories only."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+A = make_lock("fix.a")
+B = make_lock("fix.b")
+
+
+def forward():
+    with A:
+        with B:
+            return 1
+
+
+def also_forward():
+    with A:
+        with B:
+            return 2

@@ -1,0 +1,10 @@
+"""Suppression-machinery fixture: one finding silenced inline, one not."""
+
+import threading
+
+SILENCED = threading.Lock()  # kllms: ignore[lock-order] — fixture: proves same-line suppression works
+
+# kllms: ignore[lock-order] — fixture: proves comment-above suppression works
+ALSO_SILENCED = threading.Lock()
+
+LOUD = threading.Lock()

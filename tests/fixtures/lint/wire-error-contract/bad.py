@@ -1,0 +1,28 @@
+"""wire-error-contract fixture: unpinned mappings and a rebuilt envelope."""
+
+
+class KLLMsError(Exception):
+    type = "api_error"
+    status_code = 500
+
+    def as_wire(self):
+        return {"error": {"message": str(self), "type": self.type}}
+
+
+class BadError(KLLMsError):
+    # Direct subclass with neither `type` nor `status_code`: falls back to
+    # the base 500 silently.
+    pass
+
+
+class PartialError(KLLMsError):
+    type = "partial"  # status_code still missing
+
+
+class WorseError(KLLMsError):
+    type = "worse"
+    status_code = 400
+
+    def as_wire(self):
+        # Rebuilds the envelope instead of extending super().as_wire().
+        return {"message": str(self)}

@@ -1,0 +1,29 @@
+"""wire-error-contract fixture: pinned mappings, envelope preserved."""
+
+
+class KLLMsError(Exception):
+    type = "api_error"
+    status_code = 500
+
+    def as_wire(self):
+        return {"error": {"message": str(self), "type": self.type}}
+
+
+class InvalidRequestError(KLLMsError):
+    type = "invalid_request_error"
+    status_code = 400
+
+    def as_wire(self):
+        wire = super().as_wire()
+        wire["error"]["param"] = "messages"
+        return wire
+
+
+class BackendUnavailableError(KLLMsError):
+    type = "backend_unavailable"
+    status_code = 503
+
+
+class EngineHungError(BackendUnavailableError):
+    # Indirect subclass: inherits the 503 mapping, nothing to pin.
+    pass

@@ -1,0 +1,331 @@
+"""kllms-check: per-rule fixture tests, CLI contract, and the tier-1 gate.
+
+Every rule is pinned twice: a ``bad`` fixture that must produce the rule's
+findings and a ``good`` fixture that must stay silent (a rule that cannot
+fire protects nothing; a rule that fires on idiomatic code gets suppressed
+into noise). The package-wide run is the tentpole gate: the real serving
+stack must be lint-clean on every PR, via the same ``--check`` entry point CI
+uses.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from k_llms_tpu.analysis.framework import (
+    DEFAULT_CONFIG,
+    RULES,
+    _ensure_rules_loaded,
+    load_project,
+    run_rules,
+    unsuppressed,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+
+EXPECTED_RULES = {
+    "lock-order",
+    "dispatch-under-lock",
+    "host-sync-hot-path",
+    "jit-recompile-hygiene",
+    "failpoint-coverage",
+    "counter-hygiene",
+    "wire-error-contract",
+}
+
+
+def run_fixture(rule_id, rel, config=None, readme=None, test_sources=None):
+    """Run one rule over one fixture subtree as a standalone project."""
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(config or {})
+    project = load_project(
+        FIXTURES, paths=[FIXTURES / rel], config=cfg, with_context=False
+    )
+    assert project.files, f"fixture {rel} matched no files"
+    assert all(f.parse_error is None for f in project.files)
+    project.readme = readme
+    project.test_sources = dict(test_sources or {})
+    return run_rules(project, [rule_id])
+
+
+def messages(findings):
+    return [f.message for f in findings if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_has_all_project_rules_with_metadata():
+    _ensure_rules_loaded()
+    assert EXPECTED_RULES <= set(RULES)
+    assert len(RULES) >= 6
+    for rid, cls in RULES.items():
+        rule = cls()
+        assert rule.id == rid
+        assert rule.summary and rule.invariant and rule.subsystem, rid
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_bad_fixture_finds_cycle_and_raw_lock():
+    msgs = messages(run_fixture("lock-order", "lock-order/bad.py"))
+    assert len(msgs) == 2
+    cycle = [m for m in msgs if "lock-order cycle" in m]
+    assert len(cycle) == 1
+    assert "fix.a" in cycle[0] and "fix.b" in cycle[0]
+    raw = [m for m in msgs if "threading.Lock()" in m]
+    assert len(raw) == 1 and "bad.RAW" in raw[0]
+
+
+def test_lock_order_good_fixture_is_clean():
+    assert messages(run_fixture("lock-order", "lock-order/good.py")) == []
+
+
+def test_dispatch_under_lock_bad_fixture():
+    msgs = messages(
+        run_fixture("dispatch-under-lock", "dispatch-under-lock/bad.py")
+    )
+    assert len(msgs) == 2
+    assert all("fix.guard" in m and "allow_dispatch" in m for m in msgs)
+
+
+def test_dispatch_under_lock_good_fixture_is_clean():
+    assert (
+        messages(run_fixture("dispatch-under-lock", "dispatch-under-lock/good.py"))
+        == []
+    )
+
+
+HOT_CFG = {"host-sync-hot-path": {"hot_functions": ["decode_step"]}}
+
+
+def test_host_sync_bad_fixture_flags_jitted_and_hot_syncs():
+    msgs = messages(
+        run_fixture("host-sync-hot-path", "host-sync-hot-path/bad.py", HOT_CFG)
+    )
+    assert len(msgs) == 3
+    assert sum("a jitted body" in m for m in msgs) == 1
+    assert sum("a configured hot function" in m for m in msgs) == 2
+    assert any("*.item" in m for m in msgs)
+    assert any("np.asarray" in m for m in msgs)
+    assert any("jax.device_get" in m for m in msgs)
+
+
+def test_host_sync_good_fixture_is_clean():
+    assert (
+        messages(
+            run_fixture(
+                "host-sync-hot-path", "host-sync-hot-path/good.py", HOT_CFG
+            )
+        )
+        == []
+    )
+
+
+def test_jit_recompile_bad_fixture():
+    msgs = messages(
+        run_fixture("jit-recompile-hygiene", "jit-recompile-hygiene/bad.py")
+    )
+    assert len(msgs) == 1
+    assert "recompiles on every call" in msgs[0]
+
+
+def test_jit_recompile_good_fixture_sanctions_every_memoized_pattern():
+    assert (
+        messages(
+            run_fixture("jit-recompile-hygiene", "jit-recompile-hygiene/good.py")
+        )
+        == []
+    )
+
+
+BAD_FP_TESTS = {
+    "tests/test_x.py": 'spec = FailSpec(action="error")\nfire("engine.launch")\n'
+}
+BAD_FP_README = "| `engine.launch` | engine | batched launch |\n"
+
+
+def test_failpoint_coverage_bad_fixture():
+    msgs = messages(
+        run_fixture(
+            "failpoint-coverage",
+            "failpoint-coverage/bad",
+            readme=BAD_FP_README,
+            test_sources=BAD_FP_TESTS,
+        )
+    )
+    assert len(msgs) == 6
+    assert sum("string literal" in m for m in msgs) == 1
+    assert sum("'engine.typo' is not registered" in m for m in msgs) == 1
+    assert sum("dead registry entry" in m for m in msgs) == 1
+    assert sum("exercised by no test" in m for m in msgs) == 1
+    assert sum("README registry-table" in m for m in msgs) == 1
+    assert sum("'hang' is never" in m for m in msgs) == 1
+
+
+GOOD_FP_TESTS = {
+    "tests/test_x.py": (
+        'FailSpec(action="error")\nFailSpec(action="hang")\n'
+        'fire("engine.launch")\nfire("engine.pages")\n'
+    )
+}
+GOOD_FP_README = (
+    "| `engine.launch` | engine | batched launch |\n"
+    "| `engine.pages` | engine | slot page release |\n"
+)
+
+
+def test_failpoint_coverage_good_fixture_is_clean():
+    assert (
+        messages(
+            run_fixture(
+                "failpoint-coverage",
+                "failpoint-coverage/good",
+                readme=GOOD_FP_README,
+                test_sources=GOOD_FP_TESTS,
+            )
+        )
+        == []
+    )
+
+
+def test_counter_hygiene_bad_fixture():
+    msgs = messages(run_fixture("counter-hygiene", "counter-hygiene/bad"))
+    assert len(msgs) == 4
+    assert sum("without declared=" in m for m in msgs) == 1
+    assert sum("'a.typo'" in m for m in msgs) == 1
+    assert sum("'stale.name'" in m and "never" in m for m in msgs) == 1
+    assert sum("not surfaced" in m and "ALPHA_EVENTS" in m for m in msgs) == 1
+
+
+def test_counter_hygiene_good_fixture_is_clean():
+    assert messages(run_fixture("counter-hygiene", "counter-hygiene/good")) == []
+
+
+def test_wire_error_contract_bad_fixture():
+    msgs = messages(
+        run_fixture("wire-error-contract", "wire-error-contract/bad.py")
+    )
+    assert len(msgs) == 3
+    assert sum("BadError" in m and "type, status_code" in m for m in msgs) == 1
+    assert sum("PartialError" in m and "status_code" in m for m in msgs) == 1
+    assert sum("WorseError.as_wire" in m for m in msgs) == 1
+
+
+def test_wire_error_contract_good_fixture_is_clean():
+    assert (
+        messages(run_fixture("wire-error-contract", "wire-error-contract/good.py"))
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery + parse errors
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppressions_cover_same_line_and_line_above():
+    findings = run_fixture("lock-order", "suppression/bad.py")
+    assert len(findings) == 3
+    silenced = [f for f in findings if f.suppressed]
+    loud = [f for f in findings if not f.suppressed]
+    assert len(silenced) == 2 and len(loud) == 1
+    assert all(f.suppress_reason for f in silenced)
+    assert "LOUD" in loud[0].message
+
+
+def test_syntax_error_becomes_parse_error_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def nope(:\n", encoding="utf-8")
+    project = load_project(
+        tmp_path, paths=[bad], config=dict(DEFAULT_CONFIG), with_context=False
+    )
+    findings = run_rules(project, ["lock-order"])
+    assert [f.rule for f in findings] == ["parse-error"]
+    assert not findings[0].suppressed
+
+
+def test_unknown_rule_id_raises():
+    project = load_project(
+        FIXTURES,
+        paths=[FIXTURES / "lock-order" / "good.py"],
+        config=dict(DEFAULT_CONFIG),
+        with_context=False,
+    )
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_rules(project, ["no-such-rule"])
+
+
+# ---------------------------------------------------------------------------
+# CLI contract + the tier-1 package gate
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "k_llms_tpu.analysis", *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+@pytest.mark.duration_budget(10)
+def test_package_is_lint_clean_via_check_cli():
+    """The tentpole gate: `python -m k_llms_tpu.analysis --check` exits 0
+    over the real package, with the full rule set enabled."""
+    proc = _cli("--check", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert set(doc) == {"root", "files", "rules", "findings", "ok"}
+    assert doc["ok"] is True
+    assert doc["findings"] == []
+    assert doc["files"] > 50
+    assert EXPECTED_RULES <= set(doc["rules"])
+
+
+def test_cli_exits_one_with_findings_on_bad_fixture():
+    proc = _cli(
+        "--root",
+        str(FIXTURES),
+        str(FIXTURES / "lock-order" / "bad.py"),
+        "--rule",
+        "lock-order",
+        "--json",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    assert doc["rules"] == ["lock-order"]
+    for f in doc["findings"]:
+        assert set(f) == {
+            "rule", "file", "line", "message", "suppressed", "suppress_reason",
+        }
+        assert f["rule"] == "lock-order" and f["line"] > 0
+
+
+def test_cli_list_rules_and_usage_error():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in EXPECTED_RULES:
+        assert rid in proc.stdout
+    proc = _cli("--rule", "no-such-rule")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_package_lint_in_process_matches_cli():
+    """Same gate without the subprocess, so failures show findings inline."""
+    project = load_project(REPO)
+    findings = unsuppressed(run_rules(project))
+    assert not findings, "\n".join(f.format() for f in findings)

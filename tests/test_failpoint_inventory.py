@@ -1,43 +1,53 @@
-"""Static failpoint inventory (PR 4 satellite): every site registered in
-``k_llms_tpu.reliability.failpoints.SITES`` must be exercised by at least one
-test, by literal name, somewhere in the test tree. A registered-but-untested
-site is dead injection surface — it suggests a hardened path that nothing
-pins, which is exactly how fault-handling code rots."""
+"""Failpoint inventory, rebuilt on the kllms-check AST scan (no hand lists).
+
+The ``failpoint-coverage`` rule extracts the registry straight from the
+``SITES`` tuple's AST and cross-checks four surfaces at once: every
+``fire()``/``fire_keyed()`` call site uses a registered literal, every
+registered site has a call site, a test that names it, and a README
+registry-table row, and every ``FailSpec`` action variant is exercised. This
+module pins that the rule (a) passes over the real repo and (b) sees exactly
+the same registry the runtime does — so the lint gate can't drift from the
+code it guards.
+"""
 
 import pathlib
 
+from k_llms_tpu.analysis.framework import load_project, run_rules, unsuppressed
+from k_llms_tpu.analysis.rules.contracts import FailpointCoverageRule
 from k_llms_tpu.reliability.failpoints import SITES
 
-TESTS_DIR = pathlib.Path(__file__).parent
-THIS_FILE = pathlib.Path(__file__).name
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-def _test_tree_text():
-    """Concatenated source of every test module except this one (which names
-    every site by construction and must not self-satisfy the check)."""
-    chunks = []
-    for path in sorted(TESTS_DIR.rglob("test_*.py")):
-        if path.name == THIS_FILE:
-            continue
-        chunks.append(path.read_text(encoding="utf-8"))
-    return "\n".join(chunks)
+def test_every_site_is_fired_tested_and_documented():
+    """The full cross-surface sweep: registry <-> call sites <-> tests <->
+    README. Any unsuppressed finding here is a rotten failpoint."""
+    project = load_project(REPO)
+    findings = unsuppressed(run_rules(project, ["failpoint-coverage"]))
+    assert not findings, "\n".join(f.format() for f in findings)
 
 
-def test_every_registered_failpoint_is_exercised():
-    tree = _test_tree_text()
-    unexercised = [site for site in SITES if site not in tree]
-    assert not unexercised, (
-        f"failpoint site(s) {unexercised} are registered in failpoints.SITES "
-        "but no test names them — add coverage or retire the site"
-    )
-
-
-def test_inventory_is_nonempty_and_names_are_registered():
-    """Guard the guard: SITES is the single source of truth and stays
-    dot-namespaced (subsystem.site), so grep hits are unambiguous."""
-    assert len(SITES) >= 12
-    assert "replica.dispatch" in SITES and "replica.probe" in SITES
-    assert "consensus.device" in SITES
-    for site in SITES:
+def test_ast_registry_matches_runtime_registry():
+    """Guard the guard: the rule's AST extraction of SITES must agree with
+    the imported runtime tuple, and sites stay dot-namespaced so grep hits
+    and README cells are unambiguous."""
+    project = load_project(REPO, with_context=False)
+    reg = project.find_file("reliability/failpoints.py")
+    assert reg is not None
+    sites = FailpointCoverageRule()._sites(reg)
+    assert set(sites) == set(SITES)
+    assert len(sites) >= 12
+    for site in sites:
         sub, _, name = site.partition(".")
         assert sub and name, f"site {site!r} must be subsystem.name"
+
+
+def test_action_whitelist_is_extracted():
+    """The FailSpec action vocabulary comes from the real membership check,
+    not a copy — if extraction breaks, coverage of action variants silently
+    stops, so pin it."""
+    project = load_project(REPO, with_context=False)
+    reg = project.find_file("reliability/failpoints.py")
+    actions = FailpointCoverageRule()._actions(reg)
+    assert len(actions) >= 4
+    assert "raise" in actions and "hang" in actions

@@ -1,0 +1,193 @@
+"""Runtime lock-order sanitizer (KLLMS_LOCKCHECK=1) unit tests.
+
+The sanitizer must (a) stay a zero-overhead pass-through when the env var is
+unset, (b) fold per-thread acquisition stacks into a global order graph and
+flag a real A->B / B->A inversion built by two threads, (c) flag device
+dispatch under a lock not declared ``allow_dispatch=True``, and (d) keep
+Condition.wait bookkeeping honest (wait releases the lock; no phantom holds).
+"""
+
+import threading
+import time
+
+import pytest
+
+from k_llms_tpu.analysis import lockcheck
+
+
+@pytest.fixture
+def checked(monkeypatch):
+    """Enable the sanitizer and isolate its process-wide state."""
+    monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    lockcheck.reset_state()
+    yield
+    lockcheck.reset_state()
+
+
+def _in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_factories_return_plain_primitives_when_disabled(monkeypatch):
+    monkeypatch.delenv("KLLMS_LOCKCHECK", raising=False)
+    assert not lockcheck.lockcheck_enabled()
+    lock = lockcheck.make_lock("t.plain")
+    rlock = lockcheck.make_rlock("t.plain_r")
+    cv = lockcheck.make_condition("t.plain_cv")
+    for obj in (lock, rlock, cv):
+        assert not isinstance(obj, lockcheck._CheckedBase)
+    with lock, rlock, cv:
+        pass
+
+
+def test_enabled_values(monkeypatch):
+    for val, expect in [("1", True), ("true", True), ("ON", True),
+                        ("0", False), ("off", False), ("", False)]:
+        monkeypatch.setenv("KLLMS_LOCKCHECK", val)
+        assert lockcheck.lockcheck_enabled() is expect
+
+
+def test_two_thread_inversion_is_reported_as_cycle(checked):
+    a = lockcheck.make_lock("t.a")
+    b = lockcheck.make_lock("t.b")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    # Sequenced via join so the test never actually deadlocks; the graph
+    # still records a->b from thread 1 and b->a from thread 2.
+    _in_thread(forward)
+    _in_thread(backward)
+
+    found = lockcheck.violations()
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0]
+    assert "t.a" in found[0] and "t.b" in found[0]
+    assert "test_lockcheck.py" in found[0]  # closing site is actionable
+    with pytest.raises(lockcheck.LockCheckError, match="lock-order cycle"):
+        lockcheck.assert_clean()
+
+
+def test_consistent_order_is_clean(checked):
+    a = lockcheck.make_lock("t.a")
+    b = lockcheck.make_lock("t.b")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    _in_thread(forward)
+    _in_thread(forward)
+    with a:
+        with b:
+            pass
+    assert set(lockcheck.graph()) == {("t.a", "t.b")}
+    lockcheck.assert_clean()
+
+
+def test_rlock_reentrancy_is_not_a_violation(checked):
+    r = lockcheck.make_rlock("t.r")
+    with r:
+        with r:
+            pass
+    lockcheck.assert_clean()
+    assert ("t.r", "t.r") not in lockcheck.graph()
+
+
+def test_same_name_instances_are_orderless_peers(checked):
+    # Per-member locks (reliability.replica.{id}) share a canonical name;
+    # nesting two distinct instances must not fabricate a self-cycle.
+    m1 = lockcheck.make_lock("t.member")
+    m2 = lockcheck.make_lock("t.member")
+    with m1:
+        with m2:
+            pass
+    with m2:
+        with m1:
+            pass
+    lockcheck.assert_clean()
+
+
+def test_dispatch_under_plain_lock_is_a_violation(checked):
+    guard = lockcheck.make_lock("t.guard")
+    with guard:
+        lockcheck.note_device_dispatch("unit step")
+    found = lockcheck.violations()
+    assert len(found) == 1
+    assert "unit step" in found[0] and "t.guard" in found[0]
+    assert "allow_dispatch" in found[0]
+
+
+def test_dispatch_under_allow_dispatch_lock_is_clean(checked):
+    gate = lockcheck.make_lock("t.gate", allow_dispatch=True)
+    with gate:
+        lockcheck.note_device_dispatch("unit step")
+    lockcheck.assert_clean()
+
+
+def test_dispatch_with_nothing_held_is_clean(checked):
+    lockcheck.note_device_dispatch("free step")
+    lockcheck.assert_clean()
+
+
+def test_condition_wait_releases_and_notify_wakes(checked):
+    cv = lockcheck.make_condition("t.cv")
+    woke = []
+    flag = []
+
+    def waiter():
+        with cv:
+            cv.wait_for(lambda: flag, timeout=5.0)
+            woke.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    # If wait() failed to release the underlying lock this acquire would
+    # block until the waiter's timeout; the join below would then fail.
+    with cv:
+        flag.append(1)
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert woke == [True]
+    lockcheck.assert_clean()
+
+
+def test_condition_hold_still_counts_for_ordering(checked):
+    cv = lockcheck.make_condition("t.cv")
+    inner = lockcheck.make_lock("t.inner")
+    with cv:
+        with inner:
+            pass
+    assert ("t.cv", "t.inner") in lockcheck.graph()
+
+
+def test_reset_state_clears_violations_and_graph(checked):
+    guard = lockcheck.make_lock("t.guard")
+    with guard:
+        lockcheck.note_device_dispatch("unit step")
+    assert lockcheck.violations()
+    lockcheck.reset_state()
+    assert lockcheck.violations() == []
+    assert lockcheck.graph() == {}
+    lockcheck.assert_clean()
+
+
+def test_violations_deduplicate(checked):
+    guard = lockcheck.make_lock("t.guard")
+    for _ in range(3):
+        with guard:
+            lockcheck.note_device_dispatch("unit step")
+    assert len(lockcheck.violations()) == 1

@@ -16,6 +16,7 @@ import time
 import pytest
 
 from _duration_guard import check_items, enforce
+from k_llms_tpu.analysis import lockcheck
 from k_llms_tpu.backends.base import ChatRequest
 from k_llms_tpu.backends.tpu import BackendConfig, HbmMemoryModel, TpuBackend
 from k_llms_tpu.engine.engine import is_resource_exhausted
@@ -617,12 +618,17 @@ def test_duration_guard_rejects_argless_marker():
 
 @pytest.mark.slow
 @pytest.mark.duration_budget(300)
-def test_overload_soak_4x_capacity_bounded_and_typed():
+def test_overload_soak_4x_capacity_bounded_and_typed(monkeypatch):
     """ISSUE 2 acceptance: sustained >= 4x over-capacity for >= 30 s with
     queue weight never over the cap, zero hung futures, every rejection a
     typed 429/503/timeout wire error, >= 1 injected RESOURCE_EXHAUSTED
     recovered via group split with all survivors completing, and drain()
-    returning with the queue empty and the worker joined."""
+    returning with the queue empty and the worker joined.
+
+    Runs under KLLMS_LOCKCHECK=1: every lock the backend creates below is
+    instrumented, and the soak must end with a clean lock-order graph."""
+    monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    lockcheck.reset_state()
     cap = 32
     b = TpuBackend(
         config=BackendConfig(
@@ -716,3 +722,7 @@ def test_overload_soak_4x_capacity_bounded_and_typed():
     assert not b.scheduler._worker.is_alive()
     with pytest.raises((ServerDrainingError, BackendUnavailableError)):
         b.chat_completion(_req(1))
+
+    # The whole soak ran under the lock sanitizer: no ordering inversions,
+    # no device dispatch under an undeclared lock.
+    lockcheck.assert_clean()

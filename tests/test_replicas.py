@@ -19,6 +19,7 @@ import time
 import pytest
 
 from k_llms_tpu import KLLMs
+from k_llms_tpu.analysis import lockcheck
 from k_llms_tpu.backends.base import (
     Backend,
     ChatRequest,
@@ -607,11 +608,16 @@ def test_hedged_dispatch_cancels_loser_through_abort_poller(tpu_members):
 
 @pytest.mark.slow
 @pytest.mark.duration_budget(120)
-def test_chaos_soak_flapping_member_under_concurrent_traffic():
+def test_chaos_soak_flapping_member_under_concurrent_traffic(monkeypatch):
     """ISSUE acceptance: a 3-member set where r1 repeatedly dies (down) and
     wedges (hang-style sleep) while concurrent traffic flows. Every request
     resolves with a typed result or typed error, zero hung futures, failovers
-    stay bounded, and the flapping member rejoins after a probe passes."""
+    stay bounded, and the flapping member rejoins after a probe passes.
+
+    Runs under KLLMS_LOCKCHECK=1: router + per-replica + breaker locks are
+    instrumented, and the soak must end with a clean lock-order graph."""
+    monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    lockcheck.reset_state()
     members = [FakeBackend(["m0"]), FakeBackend(["m1"]), FakeBackend(["m2"])]
     rs = ReplicaSet(
         members=members,
@@ -684,3 +690,4 @@ def test_chaos_soak_flapping_member_under_concurrent_traffic():
     h = rs.health()
     assert h["state"] == "ready" and h["healthy_members"] == 3
     _shutdown(rs)
+    lockcheck.assert_clean()

@@ -18,6 +18,7 @@ import time
 import pytest
 
 from k_llms_tpu import KLLMs
+from k_llms_tpu.analysis import lockcheck
 from k_llms_tpu.reliability import failpoints as fp
 from k_llms_tpu.reliability.failpoints import FailSpec
 from k_llms_tpu.reliability.supervisor import EngineSupervisor, LaunchBudgetModel
@@ -450,11 +451,17 @@ def test_rebuild_exhaustion_stops_scheduler_with_typed_503():
 
 @pytest.mark.slow
 @pytest.mark.duration_budget(180)
-def test_chaos_soak_hang_and_nan_mid_traffic():
+def test_chaos_soak_hang_and_nan_mid_traffic(monkeypatch):
     """ISSUE acceptance chaos soak: a hung launch AND NaN poison injected
     under concurrent traffic. Every request resolves (success, degraded, or
     typed error), zero hung futures, rebuilds stay bounded, and the engine
-    returns to READY for clean traffic afterwards."""
+    returns to READY for clean traffic afterwards.
+
+    Runs under KLLMS_LOCKCHECK=1: rebuild/replay churn exercises the
+    supervisor, scheduler, and engine locks together; the soak must end with
+    a clean lock-order graph."""
+    monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    lockcheck.reset_state()
     # Budget 8 s: far below the 30 s hang (the watchdog MUST fire) but roomy
     # enough that a post-rebuild replay — full recompile + a 32-row coalesced
     # decode — finishes inside it even on a loaded CI machine. A too-tight
@@ -504,3 +511,4 @@ def test_chaos_soak_hang_and_nan_mid_traffic():
     assert len(cc.choices) == 2
     assert b.health()["state"] in ("ready", "degraded")
     b.close()
+    lockcheck.assert_clean()
