@@ -517,6 +517,201 @@ def bench_paged_kv() -> dict:
     }
 
 
+def bench_paged_attention() -> dict:
+    """Fused paged decode attention vs the materializing step it replaced
+    (hermetic, CPU-safe).
+
+    Timed: one paged decode-attention step at n in {8, 32} on the tiny head
+    geometry — ``paged_decode_attention_xla`` (the fused op: gather feeds the
+    scores directly, this step's fresh column folded in without a pool
+    round-trip) vs the PR 7 movement (gather to a dense copy, dense attention
+    over the copy, then ``take_along_axis`` to re-extract the written column
+    for the pool scatter). p50 over repeated jitted calls.
+
+    Static: the per-step gather traffic BOTH XLA paths materialize — and the
+    Pallas kernel's BlockSpec indirection reads in place instead — at the
+    real int8 8B footprint, via ``jax.eval_shape`` only (no weights, no
+    device): the repeated-extraction prompt shape (1408 tokens, shared by
+    each request's fan-out) plus per-row gen slots, every layer, per decode
+    step."""
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k_llms_tpu.models import get_config
+    from k_llms_tpu.models.llama import (
+        _gqa_scores,
+        _gqa_scores_shared,
+        _gqa_values,
+        _gqa_values_shared,
+    )
+    from k_llms_tpu.ops.attention import gather_kv_pages
+    from k_llms_tpu.ops.paged_attention import paged_decode_attention_xla
+
+    cfg = get_config("tiny")
+    D, QH, KVH = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    sm_scale = 1.0 / float(np.sqrt(D))
+    ps, P, G = 8, 64, 32
+    rng = np.random.default_rng(17)
+
+    def materializing(
+        q, pool_k, pool_v, prefix_idx, gen_idx, new_k, new_v, write_index,
+        key_mask, prefix_mask,
+    ):
+        # The PR 7 step, operation for operation: gather both regions to a
+        # dense copy, run the dense attention over the copy, re-extract the
+        # written column from the copy for the pool scatter.
+        pk, pv = gather_kv_pages(pool_k, pool_v, prefix_idx)
+        gk, gv = gather_kv_pages(pool_k, pool_v, gen_idx)
+        row_update = jax.vmap(
+            lambda c, kk, off: jax.lax.dynamic_update_slice_in_dim(
+                c, kk, off, axis=0
+            )
+        )
+        gk = row_update(gk, new_k, write_index)
+        gv = row_update(gv, new_v, write_index)
+        neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where(
+            key_mask[:, None, :, :], _gqa_scores(q, gk) * sm_scale, neg
+        )
+        p_scores = jnp.where(
+            prefix_mask[:, None, :, :], _gqa_scores_shared(q, pk) * sm_scale, neg
+        )
+        w = jax.nn.softmax(jnp.concatenate([p_scores, scores], axis=-1), axis=-1)
+        out = _gqa_values_shared(w[..., :P], pv) + _gqa_values(w[..., P:], gv)
+        idx = write_index[:, None, None, None]
+        return (
+            out,
+            jnp.take_along_axis(gk, idx, axis=1)[:, 0],
+            jnp.take_along_axis(gv, idx, axis=1)[:, 0],
+        )
+
+    def timed_row(n: int) -> dict:
+        B = n
+        npages = P // ps + B * (G // ps) + 1
+        flat = npages * ps
+        pool_k = jnp.asarray(rng.standard_normal((flat, KVH, D)), jnp.float32)
+        pool_v = jnp.asarray(rng.standard_normal((flat, KVH, D)), jnp.float32)
+        # One request, n rows sharing its prefix (the consensus fan-out
+        # shape): request-level [1, P] prefix table, per-row gen slots.
+        prefix_idx = jnp.asarray(
+            (np.arange(P) + ps)[None, :], jnp.int32
+        )
+        gen_pages = (P // ps + 1) + np.arange(B * (G // ps)).reshape(B, G // ps)
+        gen_idx = jnp.asarray(
+            (gen_pages[:, np.repeat(np.arange(G // ps), ps)] * ps
+             + np.tile(np.arange(ps), G // ps)[None, :]),
+            jnp.int32,
+        )
+        q = jnp.asarray(rng.standard_normal((B, 1, QH, D)), jnp.float32)
+        new_k = jnp.asarray(rng.standard_normal((B, 1, KVH, D)), jnp.float32)
+        new_v = jnp.asarray(rng.standard_normal((B, 1, KVH, D)), jnp.float32)
+        glen, plen = G // 2, P - 3
+        write_index = jnp.full((B,), glen, jnp.int32)
+        key_mask = jnp.broadcast_to(jnp.arange(G) <= glen, (B, 1, G))
+        prefix_mask = jnp.broadcast_to(jnp.arange(P) < plen, (B, 1, P))
+        args = (
+            q, pool_k, pool_v, prefix_idx, gen_idx, new_k, new_v,
+            write_index, key_mask, prefix_mask,
+        )
+        fused = jax.jit(
+            functools.partial(paged_decode_attention_xla, sm_scale=sm_scale)
+        )
+        mat = jax.jit(materializing)
+
+        def p50(fn) -> float:
+            jax.block_until_ready(fn(*args))  # compile
+            samples = []
+            for _ in range(30):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*args))
+                samples.append(time.perf_counter() - t0)
+            return statistics.median(samples)
+
+        f, m = p50(fused), p50(mat)
+        return {
+            "n": n,
+            "fused_xla_p50_us": round(f * 1e6, 1),
+            "materializing_p50_us": round(m * 1e6, 1),
+            "speedup_x": round(m / max(f, 1e-12), 2),
+        }
+
+    # Static gather accounting at the 8B int8 deployment shape: what the
+    # take_along_axis gather materializes per decode step across all layers.
+    from k_llms_tpu.backends.tpu import BackendConfig
+    from k_llms_tpu.models.quant import init_params_quantized
+
+    cfg8 = get_config(FLAGSHIP)
+    shapes = jax.eval_shape(
+        lambda key: init_params_quantized(cfg8, key, bits=8),
+        jax.ShapeDtypeStruct((2,), np.uint32),
+    )
+    param_bytes = sum(
+        int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree.leaves(shapes)
+    )
+    ps8 = BackendConfig.model_fields["kv_page_size"].default
+    prompt_len, gen_bucket = 1408, MAX_NEW
+    pool_shape = jax.ShapeDtypeStruct(
+        (64 * ps8, cfg8.num_kv_heads, cfg8.head_dim), cfg8.jax_dtype
+    )
+
+    def gather_bytes(n: int) -> int:
+        outs = jax.eval_shape(
+            gather_kv_pages, pool_shape, pool_shape,
+            jax.ShapeDtypeStruct((1, prompt_len), np.int32),
+        ) + jax.eval_shape(
+            gather_kv_pages, pool_shape, pool_shape,
+            jax.ShapeDtypeStruct((n, gen_bucket), np.int32),
+        )
+        per_layer = sum(
+            int(np.prod(o.shape)) * np.dtype(o.dtype).itemsize for o in outs
+        )
+        return per_layer * cfg8.num_layers
+
+    # Coalesced admitted-width accounting: the per-launch row cap
+    # generate_many's scheduler hint derives from paged_max_rows (each row
+    # charged its gen reserve plus a 1/n share of the shared prompt) vs the
+    # dense-layout cap the coalesced path used before it went paged.
+    from k_llms_tpu.backends.tpu import HbmMemoryModel
+
+    mm = HbmMemoryModel(cfg8, param_bytes=param_bytes, hbm_bytes=16 << 30)
+    dense_rows = mm.max_rows(prompt_len + gen_bucket)
+
+    def width_row(n: int) -> dict:
+        paged_rows = mm.paged_max_rows(prompt_len, gen_bucket, ps8, fanout=n)
+        return {
+            "fanout": n,
+            "dense_max_rows": dense_rows,
+            "paged_max_rows": paged_rows,
+            "width_ratio_x": round(paged_rows / max(1, dense_rows), 2),
+        }
+
+    return {
+        "timed_tiny": [timed_row(8), timed_row(32)],
+        "accounting_8b": {
+            "model": FLAGSHIP,
+            "quantization": "int8",
+            "param_bytes": param_bytes,
+            "prompt_len": prompt_len,
+            "gen_bucket": gen_bucket,
+            "page_size": ps8,
+            "gather_bytes_per_step_n8": gather_bytes(8),
+            "gather_bytes_per_step_n32": gather_bytes(32),
+            "coalesced_width_n8": width_row(8),
+            "coalesced_width_n32": width_row(32),
+            "note": (
+                "bytes the XLA paths materialize per decode step (all "
+                "layers, shared [1, P] prefix + per-row gen slots); the "
+                "Pallas kernel reads pages in place through its BlockSpec "
+                "index_map instead. coalesced_width_*: the paged-vs-dense "
+                "per-launch row caps generate_many admits against"
+            ),
+        },
+    }
+
+
 def bench_host_consensus() -> dict:
     """Host-side consolidation latency at the headline n=32 (hermetic, no
     device): the consensus stage every request pays after decode. Runs cold
@@ -884,6 +1079,10 @@ def main() -> None:
         detail["paged_kv"] = bench_paged_kv()
     except Exception as exc:  # hermetic like quality; a failure here is a bug
         detail["paged_kv"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        detail["paged_attention"] = bench_paged_attention()
+    except Exception as exc:  # hermetic like quality; a failure here is a bug
+        detail["paged_attention"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     try:
         detail["hedging"] = bench_hedging()
     except Exception as exc:  # hermetic like quality; a failure here is a bug

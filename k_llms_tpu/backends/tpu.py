@@ -180,6 +180,17 @@ class BackendConfig(BaseModel):
     # Total pool pages. None = sized by the continuous loop from its own
     # width/prompt/new bounds (worst-case no-sharing occupancy plus slack).
     kv_pool_pages: Optional[int] = None
+    # -- paged decode everywhere (PR 11) ----------------------------------
+    # Paged-attention implementation for paged decode steps: "auto" picks
+    # the fused Pallas kernel on TPU and the jittable XLA reference
+    # elsewhere; "pallas" requests the kernel explicitly (COUNTED fallback
+    # to XLA when unavailable — kernel.paged_attn_fallback); "xla" forces
+    # the reference. See ops/paged_attention.py.
+    paged_attention_impl: str = "auto"
+    # Route coalesced generate_many batches through the page pool too
+    # (block-table decode, prompt pages shared via admission; byte-identical
+    # tokens to dense). False keeps coalesced batches on dense rows.
+    paged_generate_many: bool = True
     # -- on-device consensus (PR 8) ---------------------------------------
     # Route consolidation's pairwise-similarity and majority-vote kernels
     # through batched JAX on the chip (consensus/device.py), with automatic
@@ -491,20 +502,43 @@ class TpuBackend(Backend):
 
         cfg = self.backend_config
         if getattr(self.engine, "kv_layout", "dense") == "paged":
-            # Paged rows share prompt pages across a fan-out; clamp against
-            # the amortized cost at the loop's own width (the fan-out bound)
-            # so shared-prefix requests aren't under-admitted by dense math.
-            cap = self.memory_model.paged_max_rows(
-                cfg.continuous_max_prompt,
-                cfg.continuous_max_new,
-                self.engine.kv_page_size,
-                fanout=cfg.continuous_width,
-            )
+            if "continuous_width" not in cfg.model_fields_set:
+                # ROADMAP: drive the admitted width to the paged HBM caps.
+                # With no explicit continuous_width the dense-era static
+                # default (8 slots) no longer binds — size the loop from the
+                # no-sharing paged cap (never overcommits; prefix sharing
+                # only adds headroom at runtime), bounded at 32 slots as a
+                # compile-size guard. Setting continuous_width overrides.
+                width = min(
+                    self.memory_model.paged_max_rows(
+                        cfg.continuous_max_prompt,
+                        cfg.continuous_max_new,
+                        self.engine.kv_page_size,
+                        fanout=1,
+                    ),
+                    32,
+                )
+            else:
+                # Paged rows share prompt pages across a fan-out; clamp
+                # against the amortized cost at the loop's own width (the
+                # fan-out bound) so shared-prefix requests aren't
+                # under-admitted by dense math.
+                width = min(
+                    cfg.continuous_width,
+                    self.memory_model.paged_max_rows(
+                        cfg.continuous_max_prompt,
+                        cfg.continuous_max_new,
+                        self.engine.kv_page_size,
+                        fanout=cfg.continuous_width,
+                    ),
+                )
         else:
-            cap = self.memory_model.max_rows(
-                cfg.continuous_max_prompt + cfg.continuous_max_new
+            width = min(
+                cfg.continuous_width,
+                self.memory_model.max_rows(
+                    cfg.continuous_max_prompt + cfg.continuous_max_new
+                ),
             )
-        width = min(cfg.continuous_width, cap)
         return ContinuousDecodeLoop(
             self.engine,
             width=max(1, width),
@@ -547,6 +581,8 @@ class TpuBackend(Backend):
             kv_layout="paged" if cfg.paged_kv else "dense",
             kv_page_size=cfg.kv_page_size,
             kv_pool_pages=cfg.kv_pool_pages,
+            paged_attention_impl=cfg.paged_attention_impl,
+            paged_generate_many=cfg.paged_generate_many,
         )
 
     def _wire_engine_hooks(self) -> None:
@@ -907,13 +943,29 @@ class TpuBackend(Backend):
         # joins is clipped to the tightest member hint.
         dp = self.engine.data_parallel_size
         rows = ((max(1, n) + dp - 1) // dp) * dp
+        if (
+            getattr(self.engine, "kv_layout", "dense") == "paged"
+            and getattr(self.engine, "paged_generate_many", False)
+            and self.backend_config.speculative is None
+            and not self.backend_config.sp_decode
+        ):
+            # Coalesced batches decode paged (engine._generate_many_paged):
+            # a request's n rows share its prompt pages, so the admission cap
+            # is the paged per-group reserve, not the dense n-dense-copies
+            # bound — shared-prefix fan-outs coalesce ~n x wider at equal HBM.
+            max_rows = self.memory_model.paged_max_rows(
+                len(prompt_ids), max_new, self.engine.kv_page_size,
+                fanout=max(1, n),
+            )
+        else:
+            max_rows = self.memory_model.max_rows(len(prompt_ids) + max_new)
         return self.scheduler.call_batched(
             batch_key,
             GenRequestSpec(list(prompt_ids), n, seed, budget, token_sink),
             run,
             weight=rows,
             budget=budget,
-            max_rows=self.memory_model.max_rows(len(prompt_ids) + max_new),
+            max_rows=max_rows,
         )
 
     def _constraint_for(self, response_format: Any):

@@ -57,6 +57,7 @@ from concurrent.futures import Future
 
 from ..analysis.lockcheck import make_condition, note_device_dispatch
 from ..models.llama import KVCache, init_cache, paged_verify_step, verify_step
+from ..ops.paged_attention import note_paged_attn_dispatch
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, ServerDrainingError
@@ -150,6 +151,7 @@ class ContinuousDecodeLoop:
         self._prefix_idx = np.zeros((self.width, self.max_prompt), np.int32)
         self._gen_idx = np.zeros((self.width, self.max_new), np.int32)
         self._step_paged_fn = None
+        self._paged_attn_impl = "xla"
         if self.paged:
             pool = getattr(engine, "_kv_pool", None)
             self._pool_pages_planned = (
@@ -318,6 +320,14 @@ class ContinuousDecodeLoop:
                 min_pages=self._pool_pages_planned
             )
             self._pool_pages_planned = self._pool.allocator.total_pages
+            # Resolve the paged-attention implementation ONCE per loop build
+            # (failpoint-aware, counted fallback) — never per step.
+            from ..ops.paged_attention import resolve_paged_attention_impl
+
+            self._paged_attn_impl = resolve_paged_attention_impl(
+                getattr(self.engine, "paged_attention_impl", "auto"),
+                config=config,
+            )
         else:
             self._prefix = init_cache(config, W, P)
             self._gen = init_cache(config, W, G)
@@ -410,6 +420,8 @@ class ContinuousDecodeLoop:
             logits, k_cols, v_cols = paged_verify_step(
                 config, params, cur[:, None], gen_lens, prompt_lens,
                 KVCache(k=pool_k, v=pool_v), prefix_idx, gen_idx,
+                attn_impl=self._paged_attn_impl,
+                page_size=self._pool.page_size,
             )
             pool_k = pool_k.at[:, write_idx].set(k_cols.astype(pool_k.dtype))
             pool_v = pool_v.at[:, write_idx].set(v_cols.astype(pool_v.dtype))
@@ -732,6 +744,7 @@ class ContinuousDecodeLoop:
                 gidx = jnp.asarray(self._gen_idx)
         if self.paged:
             pool = self._pool
+            note_paged_attn_dispatch(self._paged_attn_impl)
             with pool.lock:
                 note_device_dispatch("continuous paged step")
                 tok, lp, new_k, new_v = self._step_paged_fn(

@@ -41,6 +41,7 @@ from ..models.llama import (
     encode,
     init_cache,
     init_params,
+    paged_verify_step,
     prefill,
     prefill_continue,
     verify_step,
@@ -272,6 +273,8 @@ class LocalEngine:
         kv_layout: str = "dense",
         kv_page_size: int = 64,
         kv_pool_pages: Optional[int] = None,
+        paged_attention_impl: str = "auto",
+        paged_generate_many: bool = True,
     ):
         self.config = get_config(config) if isinstance(config, str) else config
         if mesh is None and use_mesh and len(jax.devices()) > 1:
@@ -433,6 +436,21 @@ class LocalEngine:
         self.kv_layout = kv_layout
         self.kv_page_size = int(kv_page_size)
         self.kv_pool_pages = kv_pool_pages
+        # Paged-attention kernel selection ("auto" picks Pallas on TPU, the
+        # jittable XLA reference elsewhere; see ops/paged_attention.py). The
+        # choice is resolved once per launch/loop build, never per step.
+        from ..ops.paged_attention import PAGED_ATTENTION_IMPLS
+
+        if paged_attention_impl not in PAGED_ATTENTION_IMPLS:
+            raise ValueError(
+                f"Unknown paged_attention_impl {paged_attention_impl!r}; "
+                f"use one of {PAGED_ATTENTION_IMPLS}"
+            )
+        self.paged_attention_impl = paged_attention_impl
+        # When the engine is paged, coalesced generate_many launches decode
+        # against pool block tables too (dense stays the fallback on pool
+        # exhaustion and the comparison baseline for differential tests).
+        self.paged_generate_many = bool(paged_generate_many)
         self._kv_pool: Optional[Any] = None
         # Serializes paged cache-entry/allocator mutation between the
         # continuous-loop worker and scheduler threads (dense entries are
@@ -1309,11 +1327,16 @@ class LocalEngine:
         sp_prefix: bool = False,
         use_cancel: bool = False,
         use_stream: bool = False,
+        paged_impl: Optional[str] = None,
     ):
         """Jitted decode loop for R requests × n_per samples each (R=1 is the
         single-request case; R>1 is the cross-request coalesced batch).
         ``sp_prefix``: the prefix KV arrives sequence-sharded from the SP
         prefill and is attended via ring decode without regathering.
+        ``paged_impl``: None decodes against dense caches; a paged-attention
+        impl name ("xla" | "pallas" | tests-only "pallas_interpret") decodes
+        against the shared page pool through block tables instead — same
+        sampler, same key schedule, byte-identical tokens on the "xla" impl.
 
         Rows are grouped request-major, so each request's shared-prefix KV is
         consumed by its own row group through the reshaped einsum in
@@ -1332,7 +1355,7 @@ class LocalEngine:
         cache_key = (
             num_requests, n_per, max_new, temperature, top_p, top_k, constraint_key,
             top_logprobs, frequency_penalty, presence_penalty, use_logit_bias,
-            use_stops, sp_prefix, use_cancel, use_stream,
+            use_stops, sp_prefix, use_cancel, use_stream, paged_impl,
         )
         fn = self._decode_cache.get(cache_key)
         if fn is not None:
@@ -1359,10 +1382,16 @@ class LocalEngine:
             )(step_keys)
             return rk.reshape(B)
 
-        def _loop(
-            params, prefix: KVCache, prompt_lens, first_logits, req_keys, eos_ids,
+        def _run_loop(
+            params, kv0, step_fn, prompt_lens, first_logits, req_keys, eos_ids,
             bias, stops, poison0,
         ):
+            # Decode-loop core shared by the dense and paged KV layouts:
+            # ``kv0`` is the opaque KV carry (a dense gen KVCache, or the
+            # paged pool's (k, v) arrays) and ``step_fn(params, cur, step,
+            # kv) -> (logits [B, V], kv)`` advances it one token. Everything
+            # else — sampling, penalties, constraints, stops, quarantine,
+            # streaming, cancellation — is layout-independent.
             # ``bias`` [V] f32 (zeros when use_logit_bias is False — a dead
             # arg then, kept so the signature is uniform): OpenAI logit_bias,
             # applied via the penalty mechanism so reported logprobs stay the
@@ -1375,12 +1404,6 @@ class LocalEngine:
             # sequences, right-aligned and -1-padded; all -1 when unused. A
             # row halts the step its recent-token window matches any stop
             # suffix, so no decode steps (or billing) run past the stop.
-            gen_cache = init_cache(config, B, max_new)
-            gen_cache = KVCache(
-                k=self._constraint(gen_cache.k, cache_specs()),
-                v=self._constraint(gen_cache.v, cache_specs()),
-            )
-
             sample = partial(
                 sample_logits, temperature=temperature, top_p=top_p, top_k=top_k
             )
@@ -1480,11 +1503,8 @@ class LocalEngine:
                 return jnp.logical_and(step < max_new - 1, jnp.logical_not(jnp.all(done)))
 
             def body(state):
-                step, cur, done, cache, toks, lps, tt, tl, counts, jst, recent, pois = state
-                logits, cache = decode_step(
-                    config, params, cur, step, prompt_lens, cache, prefix,
-                    sp_ring_mesh=self.mesh if sp_prefix else None,
-                )
+                step, cur, done, kv, toks, lps, tt, tl, counts, jst, recent, pois = state
+                logits, kv = step_fn(params, cur, step, kv)
                 if jst is not None:
                     logits = mask_logits(jt, logits, *jst, eos_ids)
                 logits = _mask_pad(logits)
@@ -1530,18 +1550,79 @@ class LocalEngine:
                     done = jnp.logical_or(done, jnp.repeat(aborted, n_per))
                 if use_stream:
                     done = jnp.logical_or(done, token_tap(step + 1, nxt))
-                return (step + 1, nxt, done, cache, toks, lps, tt, tl, counts, jst, recent, pois)
+                return (step + 1, nxt, done, kv, toks, lps, tt, tl, counts, jst, recent, pois)
 
             state = (
-                jnp.int32(0), tok0, done0, gen_cache, tokens_buf, logprob_buf,
+                jnp.int32(0), tok0, done0, kv0, tokens_buf, logprob_buf,
                 tt_buf, tl_buf, counts0, jstate, recent0, bad0,
             )
-            step, cur, done, cache, toks, lps, tt, tl, _, _, _, pois = lax.while_loop(
+            step, cur, done, kv, toks, lps, tt, tl, _, _, _, pois = lax.while_loop(
                 cond, body, state
             )
-            return toks, lps, done, tt, tl, pois
+            return toks, lps, done, tt, tl, pois, kv
 
-        fn = jax.jit(_loop)
+        if paged_impl is None:
+
+            def _loop(
+                params, prefix: KVCache, prompt_lens, first_logits, req_keys,
+                eos_ids, bias, stops, poison0,
+            ):
+                gen_cache = init_cache(config, B, max_new)
+                gen_cache = KVCache(
+                    k=self._constraint(gen_cache.k, cache_specs()),
+                    v=self._constraint(gen_cache.v, cache_specs()),
+                )
+
+                def step_fn(params, cur, step, cache):
+                    return decode_step(
+                        config, params, cur, step, prompt_lens, cache, prefix,
+                        sp_ring_mesh=self.mesh if sp_prefix else None,
+                    )
+
+                toks, lps, done, tt, tl, pois, _ = _run_loop(
+                    params, gen_cache, step_fn, prompt_lens, first_logits,
+                    req_keys, eos_ids, bias, stops, poison0,
+                )
+                return toks, lps, done, tt, tl, pois
+
+            fn = jax.jit(_loop)
+        else:
+            page_size = self.kv_page_size
+
+            def _loop(
+                params, pool_k, pool_v, prefix_idx, gen_idx, prompt_lens,
+                first_logits, req_keys, eos_ids, bias, stops, poison0,
+            ):
+                # Paged twin: rows decode through block tables into the
+                # shared page pool. prefix_idx [R, P] is request-level (the
+                # gathered prefix keeps the exact [R, P, KVH, D] shape the
+                # dense shared-prefix einsum consumes — bit-identity);
+                # gen_idx [B, G] maps gen position g to each row's reserved
+                # flat slot. The pool arrays are donated and returned: the
+                # scatter happens in place on device, and the caller swaps
+                # them back into the pool under its lock.
+                def step_fn(params, cur, step, kv):
+                    pool_k, pool_v = kv
+                    logits, k_cols, v_cols = paged_verify_step(
+                        config, params, cur[:, None],
+                        jnp.broadcast_to(step, (B,)), prompt_lens,
+                        KVCache(k=pool_k, v=pool_v), prefix_idx, gen_idx,
+                        attn_impl=paged_impl, page_size=page_size,
+                    )
+                    slots = lax.dynamic_index_in_dim(
+                        gen_idx, step, axis=1, keepdims=False
+                    )
+                    pool_k = pool_k.at[:, slots].set(k_cols)
+                    pool_v = pool_v.at[:, slots].set(v_cols)
+                    return logits[:, 0], (pool_k, pool_v)
+
+                toks, lps, done, tt, tl, pois, (pool_k, pool_v) = _run_loop(
+                    params, (pool_k, pool_v), step_fn, prompt_lens,
+                    first_logits, req_keys, eos_ids, bias, stops, poison0,
+                )
+                return toks, lps, done, tt, tl, pois, pool_k, pool_v
+
+            fn = jax.jit(_loop, donate_argnums=(1, 2))
         self._decode_cache[cache_key] = fn
         return fn
 
@@ -2482,6 +2563,38 @@ class LocalEngine:
         n_per = max(max(1, it.n) for it in items)
         n_per = ((n_per + dp - 1) // dp) * dp
 
+        # Paged coalesced decode (the tentpole of the paged-everywhere PR):
+        # when the engine's KV layout is paged, the batch decodes against pool
+        # block tables — prompt KV admitted through the same refcounted cache
+        # the continuous loop uses (cache hits cost zero device work), gen
+        # slots drawn from the pool per row. Speculative and sequence-parallel
+        # prefixes keep their dense layouts; pool exhaustion falls through to
+        # the dense body below — correctness never depends on pages being
+        # available.
+        if (
+            self.kv_layout == "paged"
+            and self.paged_generate_many
+            and self.speculative is None
+            and not (self.sp_decode and self.mesh is not None)
+        ):
+            from .paging import PagePoolExhausted
+
+            try:
+                return self._generate_many_paged(
+                    items, preps, n_per,
+                    max_new_tokens=max_new_tokens, temperature=temperature,
+                    top_p=top_p, top_k=top_k, eos_arr=eos_arr,
+                    constraint=constraint, top_logprobs=top_logprobs,
+                    frequency_penalty=frequency_penalty,
+                    presence_penalty=presence_penalty, logit_bias=logit_bias,
+                    stop_sequences=stop_sequences,
+                )
+            except PagePoolExhausted:
+                logger.debug(
+                    "paged coalesced launch exhausted the page pool; "
+                    "falling back to dense decode"
+                )
+
         first_list, k_list, v_list = [], [], []
         for ids, prompt_len, bucket in preps:
             # Per-request routing: a coalesced batch gets the same SP and
@@ -2591,6 +2704,203 @@ class LocalEngine:
         finally:
             self._active_budgets = None
             self._active_token_sinks = None
+        results = self._slice_many_results(
+            items, preps, n_per, toks_np, lps_np, done_np, tt_np, tl_np,
+            top_logprobs, spec_stats_fn=lambda lo, n_j: {}, pois_np=pois_np,
+        )
+        self._note_quarantine(
+            int(pois_np[np.asarray(live, np.int64)].sum()), len(live)
+        )
+        return self._finalize_many(items, results)
+
+    def _generate_many_paged(
+        self,
+        items: Sequence[GenRequestSpec],
+        preps,
+        n_per: int,
+        *,
+        max_new_tokens: int,
+        temperature: float,
+        top_p: Optional[float],
+        top_k: Optional[int],
+        eos_arr,
+        constraint: Optional[str],
+        top_logprobs: Optional[int],
+        frequency_penalty: float,
+        presence_penalty: float,
+        logit_bias: Optional[Dict[int, float]],
+        stop_sequences: Optional[Sequence[Sequence[int]]],
+    ) -> List[Any]:
+        """The coalesced batch, decoded against ``PagedKVPool`` block tables.
+
+        Differences from the dense body of :meth:`_generate_many_attempt` —
+        the sampler, key schedule, masks, and result assembly are shared, so
+        tokens and logprobs are byte-identical on the "xla" impl (pinned by
+        tests/test_paged_coalesced.py):
+
+        * Prompt KV is ADMITTED, not stacked: :meth:`paged_admit_prefix`
+          returns a refcounted page run per request (a paged cache hit costs
+          zero device work; an n-way fan-out's prompt is stored once
+          physically). Each run is pinned for the launch and unpinned in the
+          ``finally`` — a transient run's admission reference is dropped
+          immediately so the pin is its only owner.
+        * Every LIVE row draws ``pages_for(max_new)`` fresh gen pages; dead
+          rows (group tails past a request's n, replicated pad requests)
+          point their gen slots at the trash page, whose contents are
+          don't-care by contract.
+        * The decode dispatches under ``pool.lock`` with the pool buffers
+          donated, and the returned buffers are swapped back atomically —
+          the same consume-and-replace discipline as every pool mover.
+
+        Raises :class:`~.paging.PagePoolExhausted` (after unwinding every
+        reference it took) when admission or gen-page allocation cannot be
+        satisfied even with eviction; the caller falls back to dense.
+        """
+        from ..ops.paged_attention import (
+            note_paged_attn_dispatch,
+            resolve_paged_attention_impl,
+        )
+        from .paging import TRASH_PAGE, flat_slots, pages_for
+
+        config = self.config
+        r_pad = _bucket(len(items), minimum=1)
+        extra = r_pad - len(items)
+        B = r_pad * n_per
+        bucket_max = max(bucket for _, _, bucket in preps)
+        live = [
+            i
+            for j, it in enumerate(items)
+            for i in range(j * n_per, j * n_per + max(1, it.n))
+        ]
+
+        gp = pages_for(max_new_tokens, self.kv_page_size)
+        # +1: page 0 is the pinned trash page, never allocatable.
+        pool = self._ensure_kv_pool(
+            min_pages=sum(pages_for(p, self.kv_page_size) for _, p, _ in preps)
+            + len(live) * gp + 1
+        )
+        ps = pool.page_size
+
+        pinned: List[Any] = []  # one launch reference per admitted run
+        gen_pages_rows: List[Optional[List[int]]] = [None] * B
+        try:
+            first_list = []
+            for ids, prompt_len, bucket in preps:
+                fl, run, transient = self.paged_admit_prefix(
+                    ids, prompt_len, bucket
+                )
+                with self._paged_mutex:
+                    run.retain()
+                    if transient:
+                        run.release()
+                pinned.append(run)
+                first_list.append(fl)
+
+            # Fresh gen pages per live row, allocated under the mutex so the
+            # reservation is atomic against the continuous loop's admissions.
+            # A partial allocation propagates PagePoolExhausted; the finally
+            # below returns whatever rows already got pages.
+            with self._paged_mutex:
+                for row in live:
+                    gen_pages_rows[row] = self._alloc_pages_with_evict(gp)
+
+            # Host-side block tables. prefix_idx is REQUEST-level [r_pad, P]
+            # (the gathered prefix keeps the [R, P, KVH, D] shape the dense
+            # shared-prefix einsum consumes); positions past each prompt
+            # retarget into the trash page — masked before any unmasked read.
+            trash = (np.arange(bucket_max) % ps + TRASH_PAGE * ps).astype(np.int32)
+            prefix_np = np.empty((r_pad, bucket_max), np.int32)
+            for j, run in enumerate(pinned):
+                row_idx = flat_slots(run.pages, np.arange(bucket_max), ps)
+                row_idx[run.plen:] = trash[run.plen:]
+                prefix_np[j] = row_idx
+            if extra:
+                # Pad requests replicate the last request's table (their rows
+                # are dead; reads stay in-bounds on pages the launch pins).
+                prefix_np[len(items):] = prefix_np[len(items) - 1]
+
+            trash_gen = (np.arange(max_new_tokens) % ps + TRASH_PAGE * ps).astype(
+                np.int32
+            )
+            gen_np = np.empty((B, max_new_tokens), np.int32)
+            for row in range(B):
+                pgs = gen_pages_rows[row]
+                gen_np[row] = (
+                    flat_slots(pgs, np.arange(max_new_tokens), ps)
+                    if pgs is not None
+                    else trash_gen
+                )
+
+            if extra:
+                first_list += [first_list[-1]] * extra
+            first_logits = jnp.concatenate(first_list, axis=0)  # [r_pad, V]
+            lens = [p for _, p, _ in preps] + [preps[-1][1]] * extra
+            prompt_lens = jnp.array(lens, jnp.int32)
+
+            seeds = [
+                it.seed
+                if it.seed is not None
+                else int.from_bytes(os.urandom(4), "little")
+                for it in items
+            ]
+            seeds += [0] * extra
+            req_keys = jnp.stack([jax.random.key(s) for s in seeds])
+
+            stop_arr, use_stops = self._stop_array(stop_sequences)
+            use_cancel = any(it.budget is not None for it in items)
+            use_stream = any(it.token_sink is not None for it in items)
+
+            # Kernel selection happens once per launch (never per step) and
+            # is counted so /metrics shows which impl production dispatched.
+            impl = resolve_paged_attention_impl(
+                self.paged_attention_impl, config=config
+            )
+            note_paged_attn_dispatch(impl)
+            loop = self._get_decode_loop(
+                r_pad, n_per, max_new_tokens, temperature, top_p, top_k,
+                constraint, top_logprobs, frequency_penalty, presence_penalty,
+                use_logit_bias=logit_bias is not None,
+                use_stops=use_stops,
+                use_cancel=use_cancel,
+                use_stream=use_stream,
+                paged_impl=impl,
+            )
+
+            self._active_budgets = [it.budget for it in items]
+            self._active_token_sinks = (
+                [it.token_sink for it in items] if use_stream else None
+            )
+            self._reset_tap_state()
+            try:
+                with pool.lock:
+                    # Dispatch-and-swap under the pool lock: the pool buffers
+                    # are donated to the loop, so self.kv must point at the
+                    # returned buffers before anyone else can dispatch.
+                    toks, lps, done, tt, tl, pois, new_k, new_v = loop(
+                        self.params, pool.kv.k, pool.kv.v,
+                        jnp.asarray(prefix_np), jnp.asarray(gen_np),
+                        prompt_lens, first_logits, req_keys, eos_arr,
+                        self._bias_array(logit_bias), stop_arr,
+                        self._poison0_array(B, live),
+                    )
+                    pool.kv = KVCache(k=new_k, v=new_v)
+                toks_np, lps_np, done_np, tt_np, tl_np, pois_np = map(
+                    np.asarray, jax.device_get((toks, lps, done, tt, tl, pois))
+                )
+            finally:
+                self._active_budgets = None
+                self._active_token_sinks = None
+        finally:
+            # Unpin launch references; on success device_get has already
+            # fenced the decode, and on failure the results are discarded, so
+            # reuse-after-free of these pages cannot corrupt a kept result.
+            with self._paged_mutex:
+                for run in pinned:
+                    pool.allocator.decref(run.pages)
+                for pgs in gen_pages_rows:
+                    if pgs is not None:
+                        pool.allocator.decref(pgs)
+
         results = self._slice_many_results(
             items, preps, n_per, toks_np, lps_np, done_np, tt_np, tl_np,
             top_logprobs, spec_stats_fn=lambda lo, n_j: {}, pois_np=pois_np,

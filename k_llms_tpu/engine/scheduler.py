@@ -757,6 +757,7 @@ class EngineScheduler:
                 "hedges_won": self._hedges_won,
             }
         self._attach_consensus(out)
+        self._attach_kernel(out)
         return out
 
     def _attach_consensus(self, out: Dict[str, Any]) -> None:
@@ -769,6 +770,17 @@ class EngineScheduler:
             out["consensus"] = prov()
         except Exception:  # pragma: no cover - observability must not throw
             pass
+
+    def _attach_kernel(self, out: Dict[str, Any]) -> None:
+        """Merge the paged-attention dispatch counters (process-global
+        KERNEL_EVENTS: which impl decode launches ran, counted fallbacks).
+        Omitted entirely until the first paged dispatch — dense-only
+        deployments see no kernel section."""
+        from ..utils.observability import KERNEL_EVENTS
+
+        snap = KERNEL_EVENTS.snapshot()
+        if snap:
+            out["kernel"] = snap
 
     def health(self) -> Dict[str, Any]:
         """Point-in-time lifecycle snapshot, shaped for a /healthz endpoint.
@@ -799,6 +811,7 @@ class EngineScheduler:
                 "drain_rate": self._drain_rate(),
             }
         self._attach_consensus(out)
+        self._attach_kernel(out)
         return out
 
     def drain(self, timeout: float = 30.0) -> bool:

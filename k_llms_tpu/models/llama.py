@@ -231,6 +231,74 @@ def _gqa_values_shared(weights: jax.Array, v: jax.Array) -> jax.Array:
     return out.reshape(B, Sq, QH, v.shape[3])
 
 
+def _attn_qkv(
+    config: ModelConfig, layer: Params, x: jax.Array, positions: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared attention head: pre-norm -> QKV projection (+ optional biases)
+    -> head split -> RoPE. Factored out of :func:`_block` so the paged twin
+    (:func:`_block_paged`) runs the exact same ops — bit-identity between the
+    dense and paged decode paths holds by construction, not by replication."""
+    B, Sq, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], config.rms_eps, config.norm_offset)
+    q, k, v = qdot(h, layer["wq"]), qdot(h, layer["wk"]), qdot(h, layer["wv"])
+    if "bq" in layer:  # Qwen2-family QKV biases (static per-config structure)
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, Sq, config.num_heads, config.head_dim)
+    k = k.reshape(B, Sq, config.num_kv_heads, config.head_dim)
+    v = v.reshape(B, Sq, config.num_kv_heads, config.head_dim)
+
+    q = rope_embed(q, positions, config.rope_theta, config.rope_scaling)
+    k = rope_embed(k, positions, config.rope_theta, config.rope_scaling)
+    return q, k, v
+
+
+def _mlp_sublayer(config: ModelConfig, layer: Params, x: jax.Array) -> jax.Array:
+    """Post-attention MLP sublayer with its residual (dense MLP or MoE)."""
+    offset = config.norm_offset
+    h = rms_norm(x, layer["mlp_norm"], config.rms_eps, offset)
+    if "w_router" in layer:  # MoE (Mixtral)
+        out = _moe_mlp(config, layer, h)
+    else:
+        gate = _activation(config, qdot(h, layer["w_gate"]))
+        up = qdot(h, layer["w_up"])
+        out = qdot(gate * up, layer["w_down"])
+    if "post_mlp_norm" in layer:
+        out = rms_norm(out, layer["post_mlp_norm"], config.rms_eps, offset)
+    return x + out
+
+
+def _attn_residual(
+    config: ModelConfig, layer: Params, x: jax.Array, attn: jax.Array
+) -> jax.Array:
+    """Attention output projection plus the block's first residual."""
+    out = qdot(attn, layer["wo"])
+    if "post_attn_norm" in layer:
+        out = rms_norm(out, layer["post_attn_norm"], config.rms_eps, config.norm_offset)
+    return x + out
+
+
+def _merge_prefix_tail(q, cache_k, cache_v, key_mask, scale, out_p, m_p, l_p):
+    """Exact logsumexp merge of a prefix-phase partial (normalized out,
+    running max m, denominator l — each [B, QH, Sq]-leading; single-query
+    callers pass Sq=1) with the per-row generated-KV tail computed in XLA.
+    Returns the merged attention [B, Sq, QH, D] f32 (caller casts/reshapes)."""
+    s_g = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, G]
+    s_g = jnp.where(key_mask[:, None, :, :], s_g, jnp.finfo(jnp.float32).min)
+    m_g = jnp.max(s_g, axis=-1)  # [B, QH, Sq]
+    p_g = jnp.exp(s_g - m_g[..., None])
+    l_g = jnp.sum(p_g, axis=-1)  # [B, QH, Sq]
+    out_g = _gqa_values(p_g, cache_v).transpose(0, 2, 1, 3)  # [B, QH, Sq, D]
+
+    m = jnp.maximum(m_p, m_g)
+    a_p = jnp.exp(m_p - m)
+    a_g = jnp.exp(m_g - m)
+    denom = l_p * a_p + l_g * a_g
+    merged = (
+        out_p * (l_p * a_p)[..., None] + out_g * a_g[..., None]
+    ) / jnp.where(denom == 0.0, 1.0, denom)[..., None]
+    return merged.transpose(0, 2, 1, 3)  # [B, Sq, QH, D]
+
+
 def _block(
     config: ModelConfig,
     layer: Params,
@@ -260,18 +328,8 @@ def _block(
     """
     B, Sq, H = x.shape
     scale = config.query_scale or 1.0 / math.sqrt(config.head_dim)
-    offset = config.norm_offset
 
-    h = rms_norm(x, layer["attn_norm"], config.rms_eps, offset)
-    q, k, v = qdot(h, layer["wq"]), qdot(h, layer["wk"]), qdot(h, layer["wv"])
-    if "bq" in layer:  # Qwen2-family QKV biases (static per-config structure)
-        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
-    q = q.reshape(B, Sq, config.num_heads, config.head_dim)
-    k = k.reshape(B, Sq, config.num_kv_heads, config.head_dim)
-    v = v.reshape(B, Sq, config.num_kv_heads, config.head_dim)
-
-    q = rope_embed(q, positions, config.rope_theta, config.rope_scaling)
-    k = rope_embed(k, positions, config.rope_theta, config.rope_scaling)
+    q, k, v = _attn_qkv(config, layer, x, positions)
 
     cache_k, cache_v = kv
     if write_index is None:
@@ -293,23 +351,11 @@ def _block(
             cache_v, v.astype(cache_v.dtype), write_index, axis=1
         )
 
-    def mlp(x: jax.Array) -> jax.Array:
-        h = rms_norm(x, layer["mlp_norm"], config.rms_eps, offset)
-        if "w_router" in layer:  # MoE (Mixtral)
-            out = _moe_mlp(config, layer, h)
-        else:
-            gate = _activation(config, qdot(h, layer["w_gate"]))
-            up = qdot(h, layer["w_up"])
-            out = qdot(gate * up, layer["w_down"])
-        if "post_mlp_norm" in layer:
-            out = rms_norm(out, layer["post_mlp_norm"], config.rms_eps, offset)
-        return x + out
+    def mlp(y: jax.Array) -> jax.Array:
+        return _mlp_sublayer(config, layer, y)
 
     def attn_out(attn: jax.Array) -> jax.Array:
-        out = qdot(attn, layer["wo"])
-        if "post_attn_norm" in layer:
-            out = rms_norm(out, layer["post_attn_norm"], config.rms_eps, offset)
-        return x + out
+        return _attn_residual(config, layer, x, attn)
 
     # Full-sequence prefill takes the Pallas flash path: prefix-length masking,
     # causal structure, attention softcap (Gemma-2) and sliding windows
@@ -366,25 +412,10 @@ def _block(
         attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
         return mlp(attn_out(attn)), (cache_k, cache_v)
 
-    def _merge_prefix_tail(out_p, m_p, l_p):
-        """Exact logsumexp merge of a prefix-phase partial (normalized out,
-        running max m, denominator l — each [B, QH, Sq]-leading; single-query
-        callers pass Sq=1) with the per-row generated-KV tail computed in XLA."""
-        s_g = _gqa_scores(q, cache_k) * scale  # [B, QH, Sq, G]
-        s_g = jnp.where(key_mask[:, None, :, :], s_g, jnp.finfo(jnp.float32).min)
-        m_g = jnp.max(s_g, axis=-1)  # [B, QH, Sq]
-        p_g = jnp.exp(s_g - m_g[..., None])
-        l_g = jnp.sum(p_g, axis=-1)  # [B, QH, Sq]
-        out_g = _gqa_values(p_g, cache_v).transpose(0, 2, 1, 3)  # [B, QH, Sq, D]
-
-        m = jnp.maximum(m_p, m_g)
-        a_p = jnp.exp(m_p - m)
-        a_g = jnp.exp(m_g - m)
-        denom = l_p * a_p + l_g * a_g
-        merged = (
-            out_p * (l_p * a_p)[..., None] + out_g * a_g[..., None]
-        ) / jnp.where(denom == 0.0, 1.0, denom)[..., None]
-        attn = merged.transpose(0, 2, 1, 3)  # [B, Sq, QH, D]
+    def _merge_tail(out_p, m_p, l_p):
+        attn = _merge_prefix_tail(
+            q, cache_k, cache_v, key_mask, scale, out_p, m_p, l_p
+        )
         return attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
 
     # Decode/verify step against a SEQUENCE-SHARDED prefix (ring attention):
@@ -423,7 +454,7 @@ def _block(
                 plen,
                 sm_scale=scale,
             )
-        return mlp(attn_out(_merge_prefix_tail(out_p, m_p, l_p))), (cache_k, cache_v)
+        return mlp(attn_out(_merge_tail(out_p, m_p, l_p))), (cache_k, cache_v)
 
     # Decode step against a shared prefix: the Pallas decode kernel streams
     # each prefix KV block from HBM once per (request, kv head) and hits it
@@ -452,7 +483,7 @@ def _block(
             interpret=jax.default_backend() != "tpu",
         )
         return (
-            mlp(attn_out(_merge_prefix_tail(out_p[:, :, None], m_p[:, :, None], l_p[:, :, None]))),
+            mlp(attn_out(_merge_tail(out_p[:, :, None], m_p[:, :, None], l_p[:, :, None]))),
             (cache_k, cache_v),
         )
 
@@ -871,6 +902,105 @@ def verify_step(
 # Paged KV path (block-table gather over a flat page pool)
 # ---------------------------------------------------------------------------
 
+def _block_paged(
+    config: ModelConfig,
+    layer: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    pool_kv_l: Tuple[jax.Array, jax.Array],
+    prefix_idx: jax.Array,
+    gen_idx: jax.Array,
+    write_index: jax.Array,
+    key_mask: jax.Array,
+    prefix_mask: jax.Array,
+    prefix_lengths: Optional[jax.Array] = None,
+    page_tables=None,
+    page_size: Optional[int] = None,
+    attn_impl: str = "xla",
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Paged twin of :func:`_block` for the ``Sq == 1`` decode/verify step.
+
+    KV comes from ONE layer's flat page pool (``pool_kv_l``) through block
+    tables; attention runs in ``ops/paged_attention.py`` — the fused Pallas
+    kernel when ``attn_impl`` selects it (block-table gather folded into the
+    K/V load, no materialized copy) or the byte-identical XLA reference
+    otherwise. Returns ``(x, (k_col, v_col))`` where the cols ``[B, KVH, D]``
+    are this step's freshly computed column in pool dtype — the caller
+    scatters them into the pool (the old path extracted the same column from
+    the written gather transient via ``take_along_axis``; taking it straight
+    from the projection is bit-identical and skips the round-trip).
+    """
+    from ..ops.paged_attention import (
+        paged_decode_attention_pallas,
+        paged_decode_attention_xla,
+    )
+
+    B, Sq, H = x.shape
+    scale = config.query_scale or 1.0 / math.sqrt(config.head_dim)
+    q, k, v = _attn_qkv(config, layer, x, positions)
+    pool_k_l, pool_v_l = pool_kv_l
+    k_col = k[:, 0].astype(pool_k_l.dtype)
+    v_col = v[:, 0].astype(pool_v_l.dtype)
+
+    if (
+        attn_impl in ("pallas", "pallas_interpret")
+        and Sq == 1
+        and page_tables is not None
+        and prefix_lengths is not None
+        and config.attn_softcap is None
+        and config.sliding_window is None
+    ):
+        prefix_pages, gen_pages, gen_phase = page_tables
+        plen = jnp.asarray(prefix_lengths, jnp.int32).reshape(-1)
+        pl_row = jnp.repeat(plen, B // plen.shape[0], total_repeat_length=B)
+        attn = paged_decode_attention_pallas(
+            q[:, 0],
+            pool_k_l,
+            pool_v_l,
+            prefix_pages,
+            gen_pages,
+            gen_phase,
+            k_col,
+            v_col,
+            pl_row,
+            write_index.astype(jnp.int32),
+            page_size=page_size,
+            sm_scale=scale,
+            interpret=attn_impl == "pallas_interpret",
+        )[:, None]  # [B, 1, QH, D]
+    else:
+        # Same gate as _block's decode_prefix_attention branch, so a config
+        # running flash decode on dense caches keeps it on paged ones.
+        flash_prefix = (
+            config.decode_attention_impl == "flash"
+            and config.sliding_window is None
+            and config.attn_softcap is None
+            and Sq == 1
+            and prefix_lengths is not None
+            and (B // prefix_idx.shape[0]) * (config.num_heads // config.num_kv_heads) >= 8
+        )
+        attn = paged_decode_attention_xla(
+            q,
+            pool_k_l,
+            pool_v_l,
+            prefix_idx,
+            gen_idx,
+            k,
+            v,
+            write_index,
+            key_mask,
+            prefix_mask,
+            sm_scale=scale,
+            softcap=config.attn_softcap,
+            prefix_lengths=prefix_lengths,
+            flash_prefix=flash_prefix,
+            interpret=jax.default_backend() != "tpu",
+        )
+    attn = attn.astype(x.dtype).reshape(B, Sq, config.q_dim)
+    x = _attn_residual(config, layer, x, attn)
+    return _mlp_sublayer(config, layer, x), (k_col, v_col)
+
+
 def _apply_stack_paged(
     config: ModelConfig,
     params: Params,
@@ -885,66 +1015,65 @@ def _apply_stack_paged(
     key_mask_global: Optional[jax.Array] = None,
     prefix_mask_global: Optional[jax.Array] = None,
     prefix_lengths: Optional[jax.Array] = None,
+    attn_impl: str = "xla",
+    page_size: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Paged twin of :func:`_apply_stack`: per-layer KV is GATHERED from a
-    flat page pool through block tables instead of read from dense caches.
+    """Paged twin of :func:`_apply_stack`: per-layer KV lives in a flat page
+    pool addressed through block tables instead of dense caches.
 
     pool_kv k/v: ``[L, total_pages * page_size, KVH, D]``; prefix_idx /
-    gen_idx: int32 ``[B, P]`` / ``[B, G]`` flat pool slots for each row's
-    prompt and generated positions (out-of-table positions map into the trash
-    page and are masked by the caller). The gather happens INSIDE the layer
-    scan, so the dense transient is one layer's worth — 1/L of a dense cache.
+    gen_idx: int32 ``[B|R, P]`` / ``[B, G]`` flat pool slots for each row's
+    prompt and generated positions (an ``[R, P]`` prefix table is shared
+    request-major like the dense shared-prefix cache; out-of-table positions
+    map into the trash page and are masked). Each layer runs
+    :func:`_block_paged`, which fuses the block-table gather into attention —
+    on the Pallas path nothing dense is ever materialized; on the XLA
+    reference the gather happens INSIDE the layer scan so the transient is
+    one layer's worth, 1/L of a dense cache.
 
-    Per layer this calls the same :func:`_block` as the dense path on the
-    gathered arrays; since unmasked gathered values are bit-identical to the
-    dense cache contents and masked slots contribute an exact 0.0 through the
-    softmax (scores forced to ``finfo.min`` before the max; ``exp`` underflows
-    to 0; ``0 * finite == 0``), the whole stack is byte-identical to
-    :func:`_apply_stack` on equal inputs. Returns ``(x, k_cols, v_cols)``
-    where the cols, ``[L, B, KVH, D]``, are each row's freshly written KV
-    column — the caller scatters them back into the pool at each row's write
-    slot (the rest of the transient would round-trip unchanged).
+    Unmasked pool values are bit-identical to dense cache contents and masked
+    slots contribute an exact 0.0 through the softmax (scores forced to
+    ``finfo.min`` before the max; ``exp`` underflows to 0; ``0 * finite ==
+    0``), so the whole stack is byte-identical to :func:`_apply_stack` on
+    equal inputs. Returns ``(x, k_cols, v_cols)`` with the cols
+    ``[L, B, KVH, D]`` — each row's freshly written KV column for the
+    caller's pool scatter.
     """
-    from ..ops.attention import gather_kv_pages
-
     local_flags = _local_layer_flags(config) if key_mask_global is not None else None
+
+    page_tables = None
+    if attn_impl in ("pallas", "pallas_interpret"):
+        from ..ops.paged_attention import paged_attention_page_tables
+
+        # Layer-invariant: hoisted out of the scan so the slot->page
+        # arithmetic runs once per step, not once per layer.
+        page_tables = paged_attention_page_tables(prefix_idx, gen_idx, page_size)
 
     def body(carry, scanned):
         x = carry
         flag = scanned.get("flag")
         if flag is None:
             km, pm = key_mask, prefix_mask
-            window_value = config.sliding_window
         else:
             km = jnp.where(flag, key_mask, key_mask_global)
             pm = jnp.where(flag, prefix_mask, prefix_mask_global)
-            from ..ops.attention import NO_WINDOW
-
-            window_value = jnp.where(
-                flag, jnp.int32(config.sliding_window), jnp.int32(NO_WINDOW)
-            )
-        pool_k_l, pool_v_l = scanned["pool"]
-        pk, pv = gather_kv_pages(pool_k_l, pool_v_l, prefix_idx)  # [B, P, KVH, D]
-        gk, gv = gather_kv_pages(pool_k_l, pool_v_l, gen_idx)  # [B, G, KVH, D]
-        x, new_kv = _block(
+        x, cols = _block_paged(
             config,
             scanned["layers"],
             x,
             positions,
-            (gk, gv),
+            scanned["pool"],
+            prefix_idx,
+            gen_idx,
             write_index,
             km,
-            prefix_kv=(pk, pv),
-            prefix_mask=pm,
+            pm,
             prefix_lengths=prefix_lengths,
-            window_value=window_value,
+            page_tables=page_tables,
+            page_size=page_size,
+            attn_impl=attn_impl,
         )
-        # Keep only the column each row just wrote at its own offset; the
-        # rest of the gathered transient is pool state that didn't change.
-        idx = write_index.reshape(-1, 1, 1, 1).astype(jnp.int32)
-        k_col = jnp.take_along_axis(new_kv[0], idx, axis=1)[:, 0]
-        v_col = jnp.take_along_axis(new_kv[1], idx, axis=1)[:, 0]
-        return x, (k_col, v_col)
+        return x, cols
 
     xs = {"layers": params["layers"], "pool": (pool_kv.k, pool_kv.v)}
     if local_flags is not None:
@@ -962,6 +1091,8 @@ def paged_verify_step(
     pool_kv: KVCache,
     prefix_idx: jax.Array,
     gen_idx: jax.Array,
+    attn_impl: str = "xla",
+    page_size: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Paged twin of :func:`verify_step` at ``Sq == 1`` — the continuous
     decode loop's step when its slots hold block tables into a shared page
@@ -969,12 +1100,15 @@ def paged_verify_step(
 
     tokens: [B, 1] current tokens; lengths: [B] generated counts (also each
     row's write offset into its gen slots); prompt_len: scalar or [R];
-    pool_kv: the flat page pool ``[L, flat, KVH, D]``; prefix_idx [B, P] /
+    pool_kv: the flat page pool ``[L, flat, KVH, D]``; prefix_idx [B|R, P] /
     gen_idx [B, G]: flat pool slots per logical position. Masks are built
     EXACTLY as in :func:`verify_step` (same shapes, same predicates), so the
-    two paths select identical ``_block`` branches and produce bit-identical
-    logits — pinned by tests/test_paged_differential.py. Returns
-    (logits f32 [B, 1, V], k_cols, v_cols [L, B, KVH, D]).
+    two paths select identical attention branches and produce bit-identical
+    logits — pinned by tests/test_paged_differential.py. ``attn_impl``
+    selects the fused attention ("xla" reference, "pallas" kernel, or the
+    tests-only "pallas_interpret"); ``page_size`` is required for the Pallas
+    paths (slot->page table derivation). Returns (logits f32 [B, 1, V],
+    k_cols, v_cols [L, B, KVH, D]).
     """
     B, Sq = tokens.shape
     G = gen_idx.shape[1]
@@ -1015,6 +1149,8 @@ def paged_verify_step(
         key_mask_global=self_mask_global,
         prefix_mask_global=prefix_mask_global,
         prefix_lengths=pl,
+        attn_impl=attn_impl,
+        page_size=page_size,
     )
     h = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     logits = _logits(config, params, h)

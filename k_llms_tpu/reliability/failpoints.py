@@ -50,6 +50,14 @@ Injection sites wired in this package:
                            the host similarity/voting path for that
                            consolidation, exercising the automatic-fallback
                            contract (zero request failures) mid-traffic
+- ``ops.paged_attn``     — evaluated when a decode loop/launch resolves its
+                           paged-attention implementation
+                           (``ops/paged_attention.py``); the ``fallback``
+                           action forces the counted degrade from the fused
+                           Pallas kernel to the XLA reference (recording
+                           ``kernel.paged_attn_fallback``), exercising the
+                           kernel-unavailable path without leaving the TPU
+                           build
 
 Actions (``FailSpec.action``):
 
@@ -80,9 +88,10 @@ Actions (``FailSpec.action``):
 - ``"leak"``         — no-op at the site itself; the paged-KV release path
                        reads ``kill`` and drops that many pages from the free
                        stack unaccounted (a simulated lost decref)
-- ``"fallback"``     — no-op at the site itself; the device-consensus scorer
-                       reads the spec and silently takes the host path for
-                       that consolidation (recording the fallback counters)
+- ``"fallback"``     — no-op at the site itself; the consumer reads the spec
+                       and silently degrades to its host/reference path while
+                       recording the fallback counters (device consensus ->
+                       host scorer; paged attention -> XLA reference)
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -97,6 +106,7 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="serving.request=disconnect:1"
     KLLMS_FAILPOINTS="engine.pages=leak:2"
     KLLMS_FAILPOINTS="consensus.device=fallback:3"
+    KLLMS_FAILPOINTS="ops.paged_attn=fallback:2"
 where the first numeric arg is ``times`` for
 raise/sleep/oom/corrupt/disconnect/fallback specs, ``times[:delay]`` for hang,
 ``kill[:seed]`` for kill_samples/nan, ``kill`` (pages to drop) for leak, and
@@ -129,6 +139,7 @@ SITES = (
     "replica.probe",
     "serving.request",
     "consensus.device",
+    "ops.paged_attn",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the

@@ -64,6 +64,7 @@ _COUNTER_GROUPS = (
     ("serve", "SERVE_EVENTS"),
     ("stream", "STREAM_EVENTS"),
     ("consensus", "CONSENSUS_EVENTS"),
+    ("kernel", "KERNEL_EVENTS"),
 )
 
 

@@ -228,6 +228,21 @@ CONSENSUS_EVENTS = EventCounters(declared=(
     "consensus.device_votes",
 ))
 
+#: Process-wide accelerator-kernel counters (kernel.paged_attn_pallas_dispatch
+#: / kernel.paged_attn_xla_dispatch — which paged-attention implementation a
+#: decode launch or continuous paged step dispatched, recorded host-side per
+#: launch, not per token; kernel.paged_attn_fallback — an explicit "pallas"
+#: request degraded to the XLA reference, whether by the ops.paged_attn
+#: failpoint or an unsupported platform/config; "auto" choosing XLA on CPU is
+#: the documented posture and is NOT counted as a fallback), fed by
+#: ops/paged_attention.py and surfaced via scheduler stats/health and
+#: ``/metrics`` as ``kllms_kernel_*``.
+KERNEL_EVENTS = EventCounters(declared=(
+    "kernel.paged_attn_pallas_dispatch",
+    "kernel.paged_attn_xla_dispatch",
+    "kernel.paged_attn_fallback",
+))
+
 #: Process-wide SSE-streaming counters (streams.opened, streams.completed,
 #: streams.aborted — closed before the final consensus event, whether by
 #: client disconnect or a mid-stream error — and tokens.streamed, the count

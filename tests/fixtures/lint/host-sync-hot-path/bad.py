@@ -1,6 +1,8 @@
 """host-sync-hot-path fixture: syncs inside a jitted body and a hot function.
 
-The test runs this with ``hot_functions = ["decode_step"]``.
+The test runs this with ``hot_functions = ["decode_step", "paged_*"]`` —
+the second entry pins the glob-pattern matching the real config relies on
+for the paged-attention op family.
 """
 
 import jax
@@ -17,3 +19,8 @@ run = jax.jit(_kernel)
 def decode_step(arrays, tok):
     host = list(map(np.asarray, arrays))  # sync callable handed to map()
     return host, jax.device_get(tok)  # direct sync
+
+
+def paged_decode_attention_ref(q, tables):
+    pages = tables.tolist()  # glob-matched hot function: sync flagged
+    return q, pages

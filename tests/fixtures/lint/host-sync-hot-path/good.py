@@ -16,6 +16,12 @@ def decode_step(params, tok):
     return run(params), tok
 
 
+def paged_decode_attention_ref(q, pool_k, tables):
+    # Matched by the ``paged_*`` glob pattern; pure device code is clean.
+    cols = pool_k[tables]
+    return q @ cols.T
+
+
 def collect_results(arrays):
     # Not jitted, not configured hot: syncing here is fine.
     return [np.asarray(a) for a in map(jax.device_get, arrays)]

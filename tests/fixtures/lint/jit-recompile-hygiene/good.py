@@ -32,3 +32,8 @@ class Engine:
             fn = jax.jit(f)
             self._cache[key] = fn  # memoized-getter idiom: store then reuse
         return fn
+
+    def _get_decode_loop(self, f):
+        # Sanctioned only via the configured ``builder_functions`` list:
+        # the test pins that the config entry is load-bearing.
+        return jax.jit(f)

@@ -105,19 +105,24 @@ def test_dispatch_under_lock_good_fixture_is_clean():
     )
 
 
-HOT_CFG = {"host-sync-hot-path": {"hot_functions": ["decode_step"]}}
+HOT_CFG = {
+    "host-sync-hot-path": {"hot_functions": ["decode_step", "paged_*"]}
+}
 
 
 def test_host_sync_bad_fixture_flags_jitted_and_hot_syncs():
     msgs = messages(
         run_fixture("host-sync-hot-path", "host-sync-hot-path/bad.py", HOT_CFG)
     )
-    assert len(msgs) == 3
+    assert len(msgs) == 4
     assert sum("a jitted body" in m for m in msgs) == 1
-    assert sum("a configured hot function" in m for m in msgs) == 2
+    assert sum("a configured hot function" in m for m in msgs) == 3
     assert any("*.item" in m for m in msgs)
     assert any("np.asarray" in m for m in msgs)
     assert any("jax.device_get" in m for m in msgs)
+    # The glob-matched paged function is flagged, pinning the pattern
+    # matching that the real `paged_decode_attention_*` config relies on.
+    assert any("*.tolist" in m and "paged_decode_attention_ref" in m for m in msgs)
 
 
 def test_host_sync_good_fixture_is_clean():
@@ -139,13 +144,31 @@ def test_jit_recompile_bad_fixture():
     assert "recompiles on every call" in msgs[0]
 
 
+JIT_CFG = {
+    "jit-recompile-hygiene": {"builder_functions": ["_get_decode_loop"]}
+}
+
+
 def test_jit_recompile_good_fixture_sanctions_every_memoized_pattern():
     assert (
         messages(
-            run_fixture("jit-recompile-hygiene", "jit-recompile-hygiene/good.py")
+            run_fixture(
+                "jit-recompile-hygiene", "jit-recompile-hygiene/good.py", JIT_CFG
+            )
         )
         == []
     )
+
+
+def test_jit_recompile_builder_config_is_load_bearing():
+    # Without the configured builder_functions entry the same fixture must
+    # fire exactly once, on the config-sanctioned builder — proving the
+    # pyproject `_get_decode_loop` entry suppresses a real finding.
+    msgs = messages(
+        run_fixture("jit-recompile-hygiene", "jit-recompile-hygiene/good.py")
+    )
+    assert len(msgs) == 1
+    assert "_get_decode_loop" in msgs[0]
 
 
 BAD_FP_TESTS = {

@@ -36,7 +36,8 @@ def test_ast_registry_matches_runtime_registry():
     assert reg is not None
     sites = FailpointCoverageRule()._sites(reg)
     assert set(sites) == set(SITES)
-    assert len(sites) >= 12
+    assert len(sites) >= 13
+    assert "ops.paged_attn" in sites  # PR 11: paged-attention kernel drill
     for site in sites:
         sub, _, name = site.partition(".")
         assert sub and name, f"site {site!r} must be subsystem.name"

@@ -1,0 +1,433 @@
+"""Fused paged-attention differentials: pallas-interpret == xla == dense.
+
+The fused op (ops/paged_attention.py) has one contract and two
+implementations. These tests pin the equivalence chain at both levels:
+
+* op level — ``paged_decode_attention_pallas(interpret=True)`` against the
+  XLA reference on synthetic pools with ragged lengths, phase-shifted
+  (continuous-layout) gen tables, and trash-page garbage, across page sizes;
+* step level — ``paged_verify_step`` against the dense ``verify_step`` on
+  identical KV contents: BITWISE for the "xla" impl (the serving CPU path),
+  greedy-token-exact + allclose for "pallas_interpret" (online softmax
+  reorders float accumulation by design);
+
+plus the selection contract: ``resolve_paged_attention_impl``'s CPU posture
+("auto" -> xla, uncounted), the COUNTED fallback for an unsatisfiable
+explicit "pallas", and the ``ops.paged_attn`` failpoint forcing the counted
+fallback — the observability drill the README registry documents.
+
+Widest page-size grids carry the ``slow`` tag; one mid-size representative
+per class stays in tier-1.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k_llms_tpu.models import get_config
+from k_llms_tpu.models.llama import KVCache, paged_verify_step, verify_step
+from k_llms_tpu.ops.paged_attention import (
+    PAGED_ATTENTION_IMPLS,
+    note_paged_attn_dispatch,
+    paged_attention_page_tables,
+    paged_decode_attention_pallas,
+    paged_decode_attention_xla,
+    resolve_paged_attention_impl,
+)
+from k_llms_tpu.reliability import failpoints as fp
+from k_llms_tpu.reliability.failpoints import FailSpec
+from k_llms_tpu.utils.observability import KERNEL_EVENTS
+
+CONFIG = get_config("tiny")
+TRASH_PAGE = 0
+
+# One fast mid-size representative; the widest/narrowest grids are slow.
+PAGE_SIZES = [
+    pytest.param(4, marks=pytest.mark.slow),
+    8,
+    pytest.param(16, marks=pytest.mark.slow),
+]
+
+
+def _params():
+    from conftest import shared_params
+
+    return shared_params(CONFIG, param_key=0)
+
+
+# ---------------------------------------------------------------------------
+# op level: synthetic pools, ragged tables, both layouts
+# ---------------------------------------------------------------------------
+
+
+def _build_tables(plens, G, ps, *, continuous):
+    """Per-row block tables the way the engine lays them out.
+
+    ``continuous=False`` is the coalesced-batch layout (gen rows start on
+    fresh pages, phase 0); ``continuous=True`` is the continuous-loop layout
+    where generated tokens continue the prompt's last partial page (phase =
+    plen % ps). Unmapped positions point into the trash page, exactly like
+    ``flat_slots`` does. Returns (prefix_idx [B, P], gen_idx [B, G],
+    total_pages)."""
+    B = len(plens)
+    P = (max(int(p) for p in plens) + ps - 1) // ps * ps  # bucket width
+    next_page = TRASH_PAGE + 1
+    prefix_idx = np.empty((B, P), np.int32)
+    gen_idx = np.empty((B, G), np.int32)
+    for b, plen in enumerate(int(p) for p in plens):
+        n_pp = -(-plen // ps)
+        ppages = list(range(next_page, next_page + n_pp))
+        next_page += n_pp
+        for p in range(P):
+            if p < plen:
+                prefix_idx[b, p] = ppages[p // ps] * ps + p % ps
+            else:
+                prefix_idx[b, p] = TRASH_PAGE * ps + p % ps
+        phase = plen % ps if continuous else 0
+        n_gp = -(-(phase + G) // ps)
+        if continuous and phase:
+            gpages = [ppages[-1]] + list(range(next_page, next_page + n_gp - 1))
+            next_page += n_gp - 1
+        else:
+            gpages = list(range(next_page, next_page + n_gp))
+            next_page += n_gp
+        for g in range(G):
+            pos = phase + g
+            gen_idx[b, g] = gpages[pos // ps] * ps + pos % ps
+    return prefix_idx, gen_idx, next_page
+
+
+@pytest.mark.parametrize("page_size", PAGE_SIZES)
+@pytest.mark.parametrize("continuous", [False, True])
+def test_op_pallas_interpret_matches_xla(page_size, continuous):
+    """Ragged prompt/gen lengths, every page-boundary alignment class
+    (mid-page, exact multiple, single-slot), trash garbage in the pool:
+    the fused kernel must agree with the reference on both the coalesced
+    (phase 0) and continuous (phase-shifted) gen layouts."""
+    ps = page_size
+    B, G = 4, 12
+    QH, KVH, D = 4, 2, 16
+    plens = np.array([1, ps, 2 * ps + 3, 2 * ps - 1], np.int32)
+    wis = np.array([0, 3, G - 1, 7], np.int32)  # per-row generated counts
+
+    prefix_idx, gen_idx, npages = _build_tables(
+        plens, G, ps, continuous=continuous
+    )
+    if continuous:
+        expect_phase = plens % ps
+        _, _, phase = paged_attention_page_tables(
+            jnp.asarray(prefix_idx), jnp.asarray(gen_idx), ps
+        )
+        np.testing.assert_array_equal(np.asarray(phase), expect_phase)
+
+    keys = jax.random.split(jax.random.key(ps + int(continuous)), 5)
+    pool_k = jax.random.normal(keys[0], (npages * ps, KVH, D), jnp.float32)
+    pool_v = jax.random.normal(keys[1], (npages * ps, KVH, D), jnp.float32)
+    q = jax.random.normal(keys[2], (B, 1, QH, D), jnp.float32)
+    nk = jax.random.normal(keys[3], (B, 1, KVH, D), jnp.float32)
+    nv = jax.random.normal(keys[4], (B, 1, KVH, D), jnp.float32)
+    sm_scale = 1.0 / math.sqrt(D)
+
+    s = np.arange(G)[None, None, :]
+    key_mask = jnp.asarray(s <= wis[:, None, None])  # fresh column included
+    c = np.arange(prefix_idx.shape[1])[None, None, :]
+    prefix_mask = jnp.asarray(c < plens[:, None, None])
+
+    out_x = paged_decode_attention_xla(
+        q, pool_k, pool_v,
+        jnp.asarray(prefix_idx), jnp.asarray(gen_idx),
+        nk, nv, jnp.asarray(wis), key_mask, prefix_mask,
+        sm_scale=sm_scale,
+    )
+    tables = paged_attention_page_tables(
+        jnp.asarray(prefix_idx), jnp.asarray(gen_idx), ps
+    )
+    out_p = paged_decode_attention_pallas(
+        q[:, 0], pool_k, pool_v, *tables, nk[:, 0], nv[:, 0],
+        jnp.asarray(plens), jnp.asarray(wis),
+        page_size=ps, sm_scale=sm_scale, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_p), np.asarray(out_x[:, 0]), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_op_shared_prefix_table_broadcasts():
+    """An [R, P] request-major prefix table (the engine's shared-prefix
+    layout) must produce the same kernel output as the explicitly repeated
+    [B, P] per-row table."""
+    ps = 8
+    B, R, G = 4, 2, 8
+    QH, KVH, D = 4, 2, 16
+    plens_req = np.array([ps + 3, 2 * ps], np.int32)
+    plens_row = np.repeat(plens_req, B // R)
+    wis = np.array([0, 2, 5, 7], np.int32)
+
+    prefix_req, _, npages0 = _build_tables(plens_req, 1, ps, continuous=False)
+    prefix_row = np.repeat(prefix_req, B // R, axis=0)
+    # Fresh gen pages per row, past the prompt pages.
+    gen_idx = np.empty((B, G), np.int32)
+    next_page = npages0
+    for b in range(B):
+        gpages = list(range(next_page, next_page + -(-G // ps)))
+        next_page += len(gpages)
+        for g in range(G):
+            gen_idx[b, g] = gpages[g // ps] * ps + g % ps
+
+    keys = jax.random.split(jax.random.key(42), 5)
+    pool_k = jax.random.normal(keys[0], (next_page * ps, KVH, D), jnp.float32)
+    pool_v = jax.random.normal(keys[1], (next_page * ps, KVH, D), jnp.float32)
+    q = jax.random.normal(keys[2], (B, QH, D), jnp.float32)
+    nk = jax.random.normal(keys[3], (B, KVH, D), jnp.float32)
+    nv = jax.random.normal(keys[4], (B, KVH, D), jnp.float32)
+    sm_scale = 1.0 / math.sqrt(D)
+
+    outs = []
+    for table in (prefix_req, prefix_row):
+        tables = paged_attention_page_tables(
+            jnp.asarray(table), jnp.asarray(gen_idx), ps
+        )
+        outs.append(
+            np.asarray(
+                paged_decode_attention_pallas(
+                    q, pool_k, pool_v, *tables, nk, nv,
+                    jnp.asarray(plens_row), jnp.asarray(wis),
+                    page_size=ps, sm_scale=sm_scale, interpret=True,
+                )
+            )
+        )
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+# ---------------------------------------------------------------------------
+# step level: paged_verify_step vs the dense verify_step oracle
+# ---------------------------------------------------------------------------
+
+
+def _step_case(ps, *, fork_gen_page=False, seed=0):
+    """Build a dense world and a paged world holding IDENTICAL KV values.
+
+    R=2 coalesced requests, 2 rows each, ragged prompt and generated
+    lengths. Invalid dense slots and the paged trash/unused pages hold
+    DIFFERENT garbage, so agreement proves the masking contract, not shared
+    zeros. ``fork_gen_page``: duplicate one live row's gen page to a fresh
+    physical page with identical contents and retarget the table — the CoW
+    layout; physical placement must be invisible."""
+    cfg = CONFIG
+    L, KVH, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    R, per, G = 2, 2, 10
+    B = R * per
+    plens_req = np.array([2 * ps + 3, ps], np.int32)
+    P = 3 * ps
+    lengths = np.array([0, 3, 5, G - 1], np.int32)
+
+    rng = np.random.default_rng(seed)
+
+    def randn(*shape):
+        return rng.standard_normal(shape).astype(np.float32)
+
+    # Dense caches: valid values shared with the pool, garbage elsewhere.
+    pref_k, pref_v = randn(L, R, P, KVH, D), randn(L, R, P, KVH, D)
+    gen_k, gen_v = randn(L, B, G, KVH, D), randn(L, B, G, KVH, D)
+
+    # Paged pool: prompt pages per request, fresh gen pages per row.
+    n_pp = [-(-int(p) // ps) for p in plens_req]
+    gp = -(-G // ps)
+    npages = 1 + sum(n_pp) + B * gp + 1  # trash + prompts + gens + fork spare
+    flat = npages * ps
+    pool_k, pool_v = randn(L, flat, KVH, D), randn(L, flat, KVH, D)
+
+    next_page = TRASH_PAGE + 1
+    prefix_idx = np.empty((R, P), np.int32)
+    for r in range(R):
+        ppages = list(range(next_page, next_page + n_pp[r]))
+        next_page += n_pp[r]
+        plen = int(plens_req[r])
+        for p in range(P):
+            if p < plen:
+                slot = ppages[p // ps] * ps + p % ps
+                pool_k[:, slot] = pref_k[:, r, p]
+                pool_v[:, slot] = pref_v[:, r, p]
+                prefix_idx[r, p] = slot
+            else:
+                prefix_idx[r, p] = TRASH_PAGE * ps + p % ps
+    gen_idx = np.empty((B, G), np.int32)
+    for b in range(B):
+        gpages = list(range(next_page, next_page + gp))
+        next_page += gp
+        for g in range(G):
+            slot = gpages[g // ps] * ps + g % ps
+            gen_idx[b, g] = slot
+            if g < lengths[b]:
+                pool_k[:, slot] = gen_k[:, b, g]
+                pool_v[:, slot] = gen_v[:, b, g]
+
+    if fork_gen_page:
+        # Copy row 3's first gen page to the spare physical page and retarget
+        # its table — byte-for-byte the pool state after a CoW copy.
+        src = int(gen_idx[3, 0]) // ps
+        dst = next_page
+        pool_k[:, dst * ps:(dst + 1) * ps] = pool_k[:, src * ps:(src + 1) * ps]
+        pool_v[:, dst * ps:(dst + 1) * ps] = pool_v[:, src * ps:(src + 1) * ps]
+        for g in range(min(ps, G)):
+            gen_idx[3, g] = dst * ps + g
+
+    tokens = rng.integers(0, cfg.vocab_size, (B, 1)).astype(np.int32)
+    dense = dict(
+        gen_cache=KVCache(k=jnp.asarray(gen_k), v=jnp.asarray(gen_v)),
+        prefix=KVCache(k=jnp.asarray(pref_k), v=jnp.asarray(pref_v)),
+    )
+    paged = dict(
+        pool_kv=KVCache(k=jnp.asarray(pool_k), v=jnp.asarray(pool_v)),
+        prefix_idx=jnp.asarray(prefix_idx),
+        gen_idx=jnp.asarray(gen_idx),
+    )
+    return (
+        jnp.asarray(tokens),
+        jnp.asarray(lengths),
+        jnp.asarray(plens_req),
+        dense,
+        paged,
+    )
+
+
+@pytest.mark.parametrize("page_size", PAGE_SIZES)
+def test_step_xla_bitwise_dense_pallas_greedy(page_size):
+    params = _params()
+    tokens, lengths, plens, dense, paged = _step_case(page_size)
+
+    logits_d, cache_d = verify_step(
+        CONFIG, params, tokens, lengths, plens,
+        dense["gen_cache"], dense["prefix"],
+    )
+    logits_x, k_cols, v_cols = paged_verify_step(
+        CONFIG, params, tokens, lengths, plens,
+        paged["pool_kv"], paged["prefix_idx"], paged["gen_idx"],
+        attn_impl="xla", page_size=page_size,
+    )
+    # The XLA impl IS the dense math over gathered pages: bitwise.
+    np.testing.assert_array_equal(np.asarray(logits_x), np.asarray(logits_d))
+    # The returned fresh columns must equal what dense wrote into its cache.
+    wi = np.asarray(lengths)
+    for b in range(tokens.shape[0]):
+        np.testing.assert_array_equal(
+            np.asarray(k_cols[:, b]), np.asarray(cache_d.k[:, b, wi[b]])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v_cols[:, b]), np.asarray(cache_d.v[:, b, wi[b]])
+        )
+
+    logits_p, _, _ = paged_verify_step(
+        CONFIG, params, tokens, lengths, plens,
+        paged["pool_kv"], paged["prefix_idx"], paged["gen_idx"],
+        attn_impl="pallas_interpret", page_size=page_size,
+    )
+    # Online softmax reorders float accumulation: greedy-token-exact is the
+    # kernel's bar, with a tight numeric band behind it.
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(logits_p, -1)), np.asarray(jnp.argmax(logits_d, -1))
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_d), rtol=3e-5, atol=3e-5
+    )
+
+
+def test_step_cow_forked_table_is_invisible():
+    """A gen page forked CoW-style (same bytes, different physical page) must
+    leave both impls' outputs unchanged: bitwise for xla vs dense, bitwise
+    for pallas forked-vs-shared (identical shapes and op order)."""
+    ps = 8
+    params = _params()
+    tokens, lengths, plens, dense, shared = _step_case(ps)
+    _, _, _, _, forked = _step_case(ps, fork_gen_page=True)
+
+    logits_d, _ = verify_step(
+        CONFIG, params, tokens, lengths, plens,
+        dense["gen_cache"], dense["prefix"],
+    )
+    logits_f, _, _ = paged_verify_step(
+        CONFIG, params, tokens, lengths, plens,
+        forked["pool_kv"], forked["prefix_idx"], forked["gen_idx"],
+        attn_impl="xla", page_size=ps,
+    )
+    np.testing.assert_array_equal(np.asarray(logits_f), np.asarray(logits_d))
+
+    outs = []
+    for world in (shared, forked):
+        logits_p, _, _ = paged_verify_step(
+            CONFIG, params, tokens, lengths, plens,
+            world["pool_kv"], world["prefix_idx"], world["gen_idx"],
+            attn_impl="pallas_interpret", page_size=ps,
+        )
+        outs.append(np.asarray(logits_p))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+# ---------------------------------------------------------------------------
+# selection, counters, and the ops.paged_attn failpoint
+# ---------------------------------------------------------------------------
+
+
+def _snap():
+    return dict(KERNEL_EVENTS.snapshot())
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+def test_resolve_cpu_posture_counts_only_unsatisfied_pallas():
+    assert jax.default_backend() != "tpu"
+    before = _snap()
+    assert resolve_paged_attention_impl("auto") == "xla"
+    assert resolve_paged_attention_impl("xla") == "xla"
+    mid = _snap()
+    # "auto" -> xla off-TPU is the documented CPU posture, NOT a fallback.
+    assert _delta(before, mid, "kernel.paged_attn_fallback") == 0
+    # An explicit "pallas" that cannot run is a COUNTED degradation.
+    assert resolve_paged_attention_impl("pallas") == "xla"
+    assert _delta(mid, _snap(), "kernel.paged_attn_fallback") == 1
+    with pytest.raises(ValueError):
+        resolve_paged_attention_impl("flash")
+    assert set(PAGED_ATTENTION_IMPLS) == {"auto", "pallas", "xla"}
+
+
+def test_ops_paged_attn_failpoint_forces_counted_fallback():
+    """ops.paged_attn=fallback:2 — the registry drill: the next two launch
+    resolutions take the counted XLA fallback regardless of the request, then
+    the spec exhausts and resolution reverts to the normal posture."""
+    before = _snap()
+    with fp.failpoints({"ops.paged_attn": FailSpec(action="fallback", times=2)}):
+        assert resolve_paged_attention_impl("auto") == "xla"  # fired (1)
+        assert resolve_paged_attention_impl("auto") == "xla"  # fired (2)
+        assert resolve_paged_attention_impl("auto") == "xla"  # exhausted
+    after = _snap()
+    assert _delta(before, after, "kernel.paged_attn_fallback") == 2
+
+
+def test_ops_paged_attn_env_syntax_parses():
+    fp.configure_from_env("ops.paged_attn=fallback:1")
+    try:
+        before = _snap()
+        assert resolve_paged_attention_impl("auto") == "xla"
+        assert _delta(before, _snap(), "kernel.paged_attn_fallback") == 1
+    finally:
+        fp.clear()
+
+
+def test_dispatch_counters_and_metrics_group():
+    before = _snap()
+    note_paged_attn_dispatch("pallas")
+    note_paged_attn_dispatch("pallas_interpret")  # counts as the kernel path
+    note_paged_attn_dispatch("xla", 3)
+    after = _snap()
+    assert _delta(before, after, "kernel.paged_attn_pallas_dispatch") == 2
+    assert _delta(before, after, "kernel.paged_attn_xla_dispatch") == 3
+
+    # The group is wired into /metrics exporting (kllms_kernel_events_total).
+    from k_llms_tpu.serving.app import _COUNTER_GROUPS
+
+    assert ("kernel", "KERNEL_EVENTS") in _COUNTER_GROUPS

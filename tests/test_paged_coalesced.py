@@ -1,0 +1,199 @@
+"""Paged generate_many vs dense: the coalesced-batch differential.
+
+PR 7 made the continuous loop decode against the page pool; the coalesced
+``generate_many`` batch still round-tripped every prompt through dense
+caches. ``_generate_many_paged`` closes that gap: admission by refcounted
+page runs, fresh gen pages per live row, the fused paged-attention step, and
+dispatch-and-swap of the donated pool buffers. Every test here pins the same
+bar as tests/test_paged_differential.py does for the loop: byte-identical
+tokens, logprobs, lengths, and finish reasons against a dense engine on
+equal inputs — including prefix-cache hits, shared/extended runs, and both
+fallback paths (the ``paged_generate_many=False`` knob and a pool too small
+to admit, which must unwind cleanly and retry dense).
+
+Engines come from the session-scoped conftest factories with the SAME keys
+tests/test_paged_differential.py uses, so the compile caches are shared.
+"""
+
+import numpy as np
+import pytest
+
+from k_llms_tpu.engine.engine import GenRequestSpec, LocalEngine
+from k_llms_tpu.models import get_config
+from k_llms_tpu.utils.observability import KERNEL_EVENTS
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def engines():
+    from conftest import shared_engine
+
+    dense = shared_engine(model="tiny")
+    # Explicit pool sizing: the pool is built once, on the first paged
+    # launch, so without it the module's first test would fix the capacity
+    # every later launch gets. Admissions here are TRANSIENT
+    # (prefix_cache_size defaults 0): the launch pin is each run's only
+    # reference, exercising the retain-then-release branch.
+    paged = shared_engine(
+        model="tiny", kv_layout="paged", kv_page_size=PAGE, kv_pool_pages=256
+    )
+    assert paged.paged_generate_many  # default on
+    return dense, paged
+
+
+PROMPT_A = list(range(3, 20))  # 17 tokens: spans 3 pages, partial tail
+PROMPT_B = list(range(5, 16))  # 11 tokens: different bucket occupancy
+PROMPT_C = PROMPT_A[:9]  # strict prefix of A: admission shares its pages
+
+
+def _items(seed0=7):
+    return [
+        GenRequestSpec(prompt_ids=PROMPT_A, n=2, seed=seed0),
+        GenRequestSpec(prompt_ids=PROMPT_B, n=3, seed=seed0 + 4),
+        GenRequestSpec(prompt_ids=PROMPT_C, n=1, seed=seed0 + 6),
+    ]
+
+
+def _assert_identical(rd, rp, top_logprobs=False):
+    assert len(rd) == len(rp)
+    for a, b in zip(rd, rp):
+        assert not isinstance(a, Exception) and not isinstance(b, Exception)
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_array_equal(a.logprobs, b.logprobs)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+        assert a.finish_reasons == b.finish_reasons
+        if top_logprobs:
+            np.testing.assert_array_equal(a.top_tokens, b.top_tokens)
+            np.testing.assert_array_equal(a.top_logprobs, b.top_logprobs)
+
+
+def _xla_dispatches():
+    return KERNEL_EVENTS.snapshot().get("kernel.paged_attn_xla_dispatch", 0)
+
+
+def test_greedy_coalesced_identical(engines):
+    """Mixed fan-outs (n=2/3/1 -> row-group padding), a shared-prefix
+    admission, greedy decode: byte-identical, and the launch must actually
+    have dispatched the paged step (counted per launch)."""
+    dense, paged = engines
+    kw = dict(max_new_tokens=10, temperature=0.0, top_p=None, top_logprobs=2)
+    before = _xla_dispatches()
+    rd = dense.generate_many(_items(), **kw)
+    rp = paged.generate_many(_items(), **kw)
+    _assert_identical(rd, rp, top_logprobs=True)
+    assert _xla_dispatches() == before + 1  # one paged launch, CPU -> xla
+
+
+def test_sampled_coalesced_identical(engines):
+    """Sampling keys derive from (seed, step, sample_idx) only — the paged
+    batch must replay the dense sampled stream exactly."""
+    dense, paged = engines
+    kw = dict(max_new_tokens=12, temperature=0.7, top_p=0.9, top_logprobs=2)
+    rd = dense.generate_many(_items(seed0=21), **kw)
+    rp = paged.generate_many(_items(seed0=21), **kw)
+    _assert_identical(rd, rp, top_logprobs=True)
+
+
+def test_prefix_cache_hit_identical(engines):
+    """Second identical launch admits every prompt through the paged prefix
+    cache (zero prefill device work) — outputs must not move."""
+    dense, _ = engines
+    from conftest import shared_engine
+
+    cached = shared_engine(
+        model="tiny", kv_layout="paged", kv_page_size=PAGE,
+        kv_pool_pages=256, prefix_cache_size=4,
+    )
+    kw = dict(max_new_tokens=8, temperature=0.6, top_p=0.95)
+    items = [
+        GenRequestSpec(prompt_ids=PROMPT_A, n=2, seed=31),
+        GenRequestSpec(prompt_ids=PROMPT_B, n=2, seed=33),
+    ]
+    rd = dense.generate_many(items, **kw)
+    rp1 = cached.generate_many(items, **kw)
+    assert cached._prefix_entries  # the admissions were cached
+    rp2 = cached.generate_many(items, **kw)  # pure cache-hit admission
+    _assert_identical(rd, rp1)
+    _assert_identical(rd, rp2)
+    # Launch pins fully unwound: only cache entries keep references.
+    cached._kv_pool.allocator.verify()
+
+
+def test_streamed_tokens_match(engines):
+    """The io_callback token tap runs inside the paged loop too: the sink
+    must observe the same (step, tokens) stream on both layouts."""
+    dense, paged = engines
+    streams = {}
+    for name, eng in (("dense", dense), ("paged", paged)):
+        got = []
+        sink = lambda step, toks, got=got: got.append(
+            (int(step), np.asarray(toks).copy())
+        )
+        items = [
+            GenRequestSpec(prompt_ids=PROMPT_B, n=2, seed=41, token_sink=sink)
+        ]
+        res = eng.generate_many(
+            items, max_new_tokens=6, temperature=0.5, top_p=0.9
+        )
+        assert not isinstance(res[0], Exception)
+        # io_callback is unordered/at-least-once: dedup + sort by step.
+        streams[name] = {s: t for s, t in sorted(got)}
+    assert streams["dense"].keys() == streams["paged"].keys()
+    for s in streams["dense"]:
+        np.testing.assert_array_equal(streams["dense"][s], streams["paged"][s])
+
+
+def test_config_knob_falls_back_dense(engines):
+    """paged_generate_many=False: the paged-layout engine keeps the legacy
+    dense-transient batch path and outputs stay identical."""
+    dense, _ = engines
+    from conftest import shared_engine
+
+    off = shared_engine(
+        model="tiny", kv_layout="paged", kv_page_size=PAGE,
+        paged_generate_many=False,
+    )
+    kw = dict(max_new_tokens=8, temperature=0.0, top_p=None)
+    before = _xla_dispatches()
+    rd = dense.generate_many(_items(seed0=51), **kw)
+    ro = off.generate_many(_items(seed0=51), **kw)
+    _assert_identical(rd, ro)
+    assert _xla_dispatches() == before  # the paged step never dispatched
+
+
+def test_pool_exhausted_unwinds_and_falls_back():
+    """A pool too small for the launch's gen reserve: the paged attempt must
+    raise internally, return every reference it took, and the dense fallback
+    must still serve the batch — byte-identical to a dense engine."""
+    cfg = get_config("tiny")
+    from conftest import shared_engine, shared_params
+
+    # Private engine: an 8-page pool (the floor) holds the 1-page prompts but
+    # not the 4 rows x pages_for(16) = 8 gen pages the launch reserves on top
+    # of them. Two items, because a 1-item batch routes to the solo path
+    # before the coalesced paged gate ever runs.
+    eng = LocalEngine(
+        cfg, params=shared_params(cfg), use_mesh=False, param_seed=0,
+        kv_layout="paged", kv_page_size=PAGE, kv_pool_pages=8,
+        prefix_cache_size=0,
+    )
+    pool = eng._ensure_kv_pool()
+    assert pool.allocator.total_pages == 8
+    free0 = pool.allocator.free_pages
+
+    dense = shared_engine(model="tiny")
+    items = [
+        GenRequestSpec(prompt_ids=list(range(2, 8)), n=2, seed=61),
+        GenRequestSpec(prompt_ids=list(range(3, 9)), n=2, seed=63),
+    ]
+    kw = dict(max_new_tokens=16, temperature=0.0, top_p=None)
+    before = _xla_dispatches()
+    rd = dense.generate_many(items, **kw)
+    rp = eng.generate_many(items, **kw)
+    _assert_identical(rd, rp)
+    # The paged step never dispatched (exhaustion precedes kernel selection)
+    # and the unwind returned every page the attempt allocated.
+    assert _xla_dispatches() == before
+    assert pool.allocator.free_pages == free0
+    pool.allocator.verify()
