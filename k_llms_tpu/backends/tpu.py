@@ -184,8 +184,8 @@ class BackendConfig(BaseModel):
     # Paged-attention implementation for paged decode steps: "auto" picks
     # the fused Pallas kernel on TPU and the jittable XLA reference
     # elsewhere; "pallas" requests the kernel explicitly (COUNTED fallback
-    # to XLA when unavailable — kernel.paged_attn_fallback); "xla" forces
-    # the reference. See ops/paged_attention.py.
+    # to XLA when unavailable — kernel.paged_attn_fallback.<reason>); "xla"
+    # forces the reference. See ops/paged_attention.py.
     paged_attention_impl: str = "auto"
     # Route coalesced generate_many batches through the page pool too
     # (block-table decode, prompt pages shared via admission; byte-identical
@@ -197,6 +197,16 @@ class BackendConfig(BaseModel):
     # per-consolidation host fallback (failpoint, busy chip, unsupported
     # payload shape, JAX unavailable). False = always the host Python path.
     device_consensus: bool = True
+    # -- constrained decoding (PR 12) --------------------------------------
+    # Compile response_format JSON schemas into token-level grammar masks
+    # (engine/grammar.py) applied in-decode, so every sample is parse-valid
+    # by construction. Compiles are memoized process-wide by (schema, vocab)
+    # digest — ReplicaSet members share one cache. Unsupported schema
+    # features degrade to the generic JSON mask; compile errors and the
+    # engine.grammar failpoint degrade to unconstrained decode — post-hoc
+    # validation in parse() stays authoritative either way (counted, see
+    # GRAMMAR_EVENTS). False = the pre-PR-12 post-hoc-only posture.
+    constrained_decoding: bool = True
 
 
 def _detect_hbm_bytes() -> Optional[int]:
@@ -489,7 +499,10 @@ class TpuBackend(Backend):
         )
         self._wire_engine_hooks()
         self._closed = False
-        self._dfa_cache: Dict[str, Any] = {}
+        # (vocab byte strings, digest) for grammar compiles — lazy, see
+        # _grammar_vocab; the compiled grammars themselves live in the
+        # PROCESS-wide cache (engine/grammar.py), shared across replicas.
+        self._grammar_vocab_cache = None
         # Continuous in-flight batching: a persistent slot-admission decode
         # loop beside the coalescing scheduler. Admission respects the same
         # DRAINING/STOPPED lifecycle (admission_gate) so drain() quiesces both.
@@ -643,11 +656,13 @@ class TpuBackend(Backend):
         max_new = request.max_tokens or self.default_max_new_tokens
         # Structured-output requests get grammar-constrained decoding (the
         # reference relies on the OpenAI server for this guarantee). A pydantic
-        # response_format compiles to a schema DFA — keys, types, and enums
-        # enforced, so every sample validates into the user's model; anything
-        # the compiler can't express falls back to the valid-JSON automaton.
-        # Byte tokenizers run the automata directly; BPE vocabularies get
-        # token-level masks compiled over the vocabulary (token_constraint.py).
+        # response_format compiles to a CompiledGrammar (engine/grammar.py) —
+        # a fleet-cached token-mask automaton over this tokenizer's byte
+        # strings, so keys, types, and enums are enforced in-decode and every
+        # sample validates into the user's model; anything the schema compiler
+        # can't express degrades to the valid-JSON mask, and compile errors /
+        # the engine.grammar failpoint / constrained_decoding=False degrade to
+        # unconstrained decode — post-hoc validation stays authoritative.
         constraint = self._constraint_for(request.response_format)
         # OpenAI semantics: top_logprobs only applies when logprobs is on.
         top_lp = request.top_logprobs if request.logprobs else None
@@ -883,12 +898,21 @@ class TpuBackend(Backend):
         # Continuous in-flight batching: qualifying requests join the
         # persistent slot loop the step after admission instead of waiting
         # behind coalesced groups. Features that key the compiled program
-        # (constraints, top_logprobs, penalties, bias) stay on the coalescing
-        # path; stop SEQUENCES qualify because the host text scan above is
-        # authoritative (the loop just decodes to eos/max_new).
+        # (top_logprobs, penalties, bias) stay on the coalescing path;
+        # CompiledGrammar constraints qualify — the loop's grammar-twin
+        # programs take the mask tables as arguments, so schemas share one
+        # program (a different schema than the loop's resident one raises
+        # ValueError below and coalesces instead); stop SEQUENCES qualify
+        # because the host text scan above is authoritative (the loop just
+        # decodes to eos/max_new).
+        from ..engine.grammar import CompiledGrammar
+
+        loop_grammar = (
+            constraint if isinstance(constraint, CompiledGrammar) else None
+        )
         if (
             self._continuous is not None
-            and constraint is None
+            and (constraint is None or loop_grammar is not None)
             and top_logprobs is None
             and frequency_penalty == 0.0
             and presence_penalty == 0.0
@@ -905,9 +929,11 @@ class TpuBackend(Backend):
                     seed=seed,
                     budget=budget,
                     token_sink=token_sink,
+                    grammar=loop_grammar,
                 ).result()
             except ValueError:
-                # Templated prompt outgrew the loop's bounds — coalescing path.
+                # Templated prompt outgrew the loop's bounds, or the loop is
+                # busy under a different grammar — coalescing path.
                 pass
 
         def run(specs):
@@ -959,7 +985,7 @@ class TpuBackend(Backend):
             )
         else:
             max_rows = self.memory_model.max_rows(len(prompt_ids) + max_new)
-        return self.scheduler.call_batched(
+        result = self.scheduler.call_batched(
             batch_key,
             GenRequestSpec(list(prompt_ids), n, seed, budget, token_sink),
             run,
@@ -967,6 +993,15 @@ class TpuBackend(Backend):
             budget=budget,
             max_rows=max_rows,
         )
+        if loop_grammar is not None:
+            # Every generated token on this path sampled under the fused
+            # mask; counted host-side after the fact (never in the loop).
+            from ..utils.observability import GRAMMAR_EVENTS
+
+            GRAMMAR_EVENTS.record(
+                "grammar.masked_steps", int(np.sum(result.lengths))
+            )
+        return result
 
     def _constraint_for(self, response_format: Any):
         if response_format is None:
@@ -987,59 +1022,30 @@ class TpuBackend(Backend):
             # {"type": "text"} and unrecognized forms are unconstrained — only
             # an explicit JSON request earns the grammar mask.
             return None
+        if not self.backend_config.constrained_decoding:
+            # Post-hoc-only posture: decode unconstrained, parse() validates
+            # after the fact (the pre-PR-12 behavior, byte-identical output).
+            return None
+        # Compile-or-fetch through the process-wide grammar cache: keyed by
+        # (schema digest, vocab digest), so every ReplicaSet member — and
+        # every concurrent request — shares one compile per schema per
+        # tokenizer. Never raises; None = unconstrained + post-hoc validation
+        # (failpoint/compile error, counted in GRAMMAR_EVENTS).
+        from ..engine.grammar import grammar_for_schema
 
-        byte_level = getattr(self.tokenizer, "is_byte_level", False)
-        if schema is not None:
-            import json
+        vocab, vocab_digest = self._grammar_vocab()
+        return grammar_for_schema(schema, vocab, vocab_digest=vocab_digest)
 
-            digest = hashlib.sha256(
-                json.dumps(schema, sort_keys=True, default=str).encode()
-            ).hexdigest()
-            cached = self._dfa_cache.get(digest)
-            if cached is not None:
-                return cached
-            from ..engine.schema_constraint import SchemaUnsupported, compile_schema
+    def _grammar_vocab(self):
+        """(per-token byte strings, digest) for this backend's tokenizer —
+        computed once; the digest is the fleet-wide grammar-cache key half."""
+        if getattr(self, "_grammar_vocab_cache", None) is None:
+            from ..engine.grammar import grammar_vocab
+            from ..engine.token_constraint import _vocab_digest
 
-            try:
-                dfa = compile_schema(schema)
-            except SchemaUnsupported as e:
-                logger.info("schema DFA unsupported (%s); using generic JSON mask", e)
-                dfa = None
-            if byte_level:
-                constraint = dfa if dfa is not None else "json"
-            else:
-                # BPE vocabularies: lift the byte automaton to token level
-                # (per-state vocab bitmasks, Outlines-style) so the grammar
-                # guarantee holds on real checkpoints too.
-                from ..engine.token_constraint import schema_token_constraint
-
-                vocab = self._vocab_bytes()
-                constraint = (
-                    schema_token_constraint(dfa, vocab)
-                    if dfa is not None
-                    else self._json_token_constraint()
-                )
-            self._dfa_cache[digest] = constraint
-            return constraint
-        if byte_level:
-            return "json"
-        return self._json_token_constraint()
-
-    def _vocab_bytes(self):
-        if getattr(self, "_vocab_bytes_cache", None) is None:
-            from ..engine.token_constraint import vocab_byte_strings
-
-            self._vocab_bytes_cache = vocab_byte_strings(self.tokenizer)
-        return self._vocab_bytes_cache
-
-    def _json_token_constraint(self):
-        cached = self._dfa_cache.get("json-token")
-        if cached is None:
-            from ..engine.token_constraint import json_token_constraint
-
-            cached = json_token_constraint(self._vocab_bytes())
-            self._dfa_cache["json-token"] = cached
-        return cached
+            vocab = grammar_vocab(self.tokenizer)
+            self._grammar_vocab_cache = (vocab, _vocab_digest(vocab))
+        return self._grammar_vocab_cache
 
     # -- embeddings -------------------------------------------------------
     def embeddings(self, texts: List[str]) -> List[List[float]]:
@@ -1125,6 +1131,14 @@ class TpuBackend(Backend):
             hbm["page_pool_bytes"] = pool.pool_bytes()
         snap["hbm"] = hbm
         snap["consensus"] = self._consensus_stats()
+        # Constrained decoding: posture flag + the process-wide compile-cache
+        # counters (merged into the scheduler's "grammar" events section when
+        # present — same key, complementary views).
+        from ..engine.grammar import grammar_cache_stats
+
+        grammar = snap.setdefault("grammar", {})
+        grammar["enabled"] = bool(self.backend_config.constrained_decoding)
+        grammar["cache"] = grammar_cache_stats()
         return snap
 
     # -- on-device consensus ----------------------------------------------

@@ -35,9 +35,17 @@ Design:
   DRAINING/STOPPED lifecycle gates admission via
   ``EngineScheduler.admission_error``.
 
-Requests that need constraints, top_logprobs, penalties, or logit_bias stay on
-the coalescing path (TpuBackend routes; see ``_generate_batched``) — those
+Requests that need top_logprobs, penalties, or logit_bias stay on the
+coalescing path (TpuBackend routes; see ``_generate_batched``) — those
 features key the compiled program, which would fragment the shared loop.
+Grammar-constrained requests (ISSUE 12) DO ride the loop: the resident
+:class:`CompiledGrammar`'s tables are *arguments* to grammar-twin step
+programs (state axis padded to a power of two by ``device_grammar``), so one
+XLA program serves every schema over the same tokenizer; per-row state/flag
+vectors gate the fused mask + advance, rows without a grammar sample
+byte-identically (and steps with no constrained row run the original
+programs untouched), and a request under a *different* schema than the
+resident one falls back to coalescing instead of fragmenting the loop.
 """
 
 from __future__ import annotations
@@ -61,7 +69,7 @@ from ..ops.paged_attention import note_paged_attn_dispatch
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, ServerDrainingError
-from ..utils.observability import FAILURE_EVENTS
+from ..utils.observability import FAILURE_EVENTS, GRAMMAR_EVENTS
 from .engine import GenerationResult, is_resource_exhausted
 from .paging import TRASH_PAGE, PagePoolExhausted, flat_slots, pages_for
 
@@ -78,6 +86,10 @@ class _SlotRequest:
     max_new: int
     budget: Optional[RequestBudget]
     token_sink: Optional[Callable[[int, np.ndarray], None]]
+    # CompiledGrammar when the request decodes under a schema mask; the loop
+    # holds ONE resident grammar's tables on device, so a different-digest
+    # request is rejected at submit (the backend reroutes it to coalescing).
+    grammar: Optional[Any] = None
     slots: List[int] = field(default_factory=list)
     # Per-sample accumulators, index-aligned with ``slots``.
     tokens: List[List[int]] = field(default_factory=list)
@@ -130,6 +142,18 @@ class ContinuousDecodeLoop:
         self._temps = np.ones((self.width,), np.float32)
         self._top_ps = np.ones((self.width,), np.float32)
         self._active_mask = np.zeros((self.width,), bool)
+        # Grammar-constrained rows: per-slot automaton state + flag mirrors,
+        # the resident CompiledGrammar (one schema's tables live on device at
+        # a time; same-digest requests share them, different-digest requests
+        # fall back to coalescing), and the memoized jitted grammar twins of
+        # the admit/step programs (tables are arguments — swapping schemas of
+        # the same padded shape reuses the compiled programs).
+        self._g_states = np.zeros((self.width,), np.int32)
+        self._g_flags = np.zeros((self.width,), bool)
+        self._grammar: Optional[Any] = None
+        self._dgrammar: Optional[Any] = None
+        self._g_programs: Optional[tuple] = None
+        self._sampler_parts: Optional[tuple] = None
         # Device KV state, built lazily on first admission (compile + HBM cost
         # only when the feature is actually used).
         self._prefix: Optional[KVCache] = None
@@ -232,9 +256,17 @@ class ContinuousDecodeLoop:
         seed: int,
         budget: Optional[RequestBudget] = None,
         token_sink: Optional[Callable[[int, np.ndarray], None]] = None,
+        grammar: Optional[Any] = None,
     ) -> Future:
         """Queue one request for slot admission; returns a Future resolving to
-        a :class:`GenerationResult` (or raising the typed lifecycle error)."""
+        a :class:`GenerationResult` (or raising the typed lifecycle error).
+
+        ``grammar`` is an optional :class:`CompiledGrammar`: the request's
+        rows then decode under the fused schema mask. The loop keeps one
+        resident grammar; a request under a different schema while
+        constrained work is queued or in flight raises ValueError (the
+        backend's qualification ``except ValueError`` reroutes it to the
+        coalescing path, which compiles its own loop per constraint)."""
         if self._admission_gate is not None:
             err = self._admission_gate()
             if err is not None:
@@ -271,8 +303,14 @@ class ContinuousDecodeLoop:
             max_new=max_new,
             budget=budget,
             token_sink=token_sink,
+            grammar=grammar,
         )
         with self._lock:
+            if grammar is not None and self._grammar_busy_locked(grammar):
+                raise ValueError(
+                    "continuous loop is decoding under a different grammar; "
+                    "take the per-constraint coalescing path"
+                )
             self._pending_prefill[id(req)] = (ids, prompt_len, seed,
                                               float(temperature),
                                               1.0 if top_p is None else float(top_p))
@@ -433,7 +471,124 @@ class ContinuousDecodeLoop:
             return tok, lp, pool_k, pool_v
 
         self._step_paged_fn = jax.jit(_step_paged, donate_argnums=(1, 2))
+        # Raw sampler pieces, reused by the grammar-twin programs so masked
+        # rows share the exact key schedule and sampler math (byte-identical
+        # tokens for rows the mask does not touch).
+        self._sampler_parts = (_row_keys, _sample_rows, _mask_pad)
         self._built = True
+
+    # -- grammar-constrained programs --------------------------------------
+
+    def _grammar_busy_locked(self, grammar: Any) -> bool:
+        """Is constrained work under a *different* schema queued or active?
+        (Same digest shares the resident tables.) Lock held by the caller."""
+        for r in self._active:
+            if r is not None and r.grammar is not None \
+                    and r.grammar.digest != grammar.digest:
+                return True
+        return any(
+            r.grammar is not None and r.grammar.digest != grammar.digest
+            for r in self._queue
+        )
+
+    def _install_grammar(self, grammar: Any) -> None:
+        """Make ``grammar`` the resident constraint: upload its tables with
+        the state axis padded to a power of two, so the next schema of the
+        same padded shape reuses the compiled grammar-twin programs."""
+        if self._grammar is not None and self._grammar.digest == grammar.digest:
+            return
+        from .grammar import device_grammar
+
+        self._grammar = grammar
+        self._dgrammar = device_grammar(grammar, pad_states=64)
+
+    def _g_tabs(self) -> tuple:
+        dg = self._dgrammar
+        return (dg.masks, dg.trans, dg.terminal, dg.token_bytes, dg.token_len)
+
+    def _grammar_programs(self) -> Dict[str, Any]:
+        """Jitted grammar twins of the admit/step programs, memoized by table
+        shape. The resident grammar's tables are ARGUMENTS (only the vocab
+        size is static), so swapping schemas over the same tokenizer and
+        padded state count re-dispatches the already-compiled programs; the
+        mask gather and state advance are fused into the step — the per-step
+        host sync stays the single result readback."""
+        dg = self._dgrammar
+        shape_key = (
+            dg.masks.shape, dg.trans.shape, dg.token_bytes.shape, dg.vocab_size
+        )
+        if self._g_programs is not None and self._g_programs[0] == shape_key:
+            return self._g_programs[1]
+        from .grammar import DeviceGrammar, grammar_advance, grammar_mask_logits
+
+        config = self.engine.config
+        pad_id = config.pad_token_id
+        row_keys, sample_rows, mask_pad = self._sampler_parts
+        vocab_size = dg.vocab_size
+        eos_arr = jnp.asarray(self.eos_ids, jnp.int32)
+
+        def _as_grammar(tabs):
+            masks, trans, terminal, token_bytes, token_len = tabs
+            return DeviceGrammar(
+                masks, trans, terminal, token_bytes, token_len, 0, vocab_size
+            )
+
+        def _apply_mask(logits, g_states, g_flags, tabs):
+            masked = grammar_mask_logits(_as_grammar(tabs), logits, g_states, eos_arr)
+            return jnp.where(g_flags[:, None], masked, logits)
+
+        def _advance(tok, g_states, g_flags, tabs):
+            nxt = grammar_advance(_as_grammar(tabs), tok, g_states)
+            return jnp.where(g_flags, nxt, g_states)
+
+        def _admit_g(first_logits, seeds, sample_idx, temps, top_ps,
+                     g_states, g_flags, *tabs):
+            logits = _apply_mask(mask_pad(first_logits), g_states, g_flags, tabs)
+            keys = row_keys(seeds, jnp.zeros_like(sample_idx), sample_idx)
+            tok, lp = sample_rows(logits, keys, temps, top_ps)
+            return tok, lp, _advance(tok, g_states, g_flags, tabs)
+
+        def _step_g(params, prefix, gen, cur, gen_lens, prompt_lens, active,
+                    seeds, sample_idx, temps, top_ps, g_states, g_flags, *tabs):
+            logits, gen = verify_step(
+                config, params, cur[:, None], gen_lens, prompt_lens, gen, prefix
+            )
+            logits = _apply_mask(
+                mask_pad(logits[:, 0, :]), g_states, g_flags, tabs
+            )
+            keys = row_keys(seeds, gen_lens + 1, sample_idx)
+            tok, lp = sample_rows(logits, keys, temps, top_ps)
+            tok = jnp.where(active, tok, jnp.int32(pad_id))
+            lp = jnp.where(active, lp, 0.0)
+            return tok, lp, gen, _advance(tok, g_states, g_flags, tabs)
+
+        def _step_paged_g(params, pool_k, pool_v, cur, gen_lens, prompt_lens,
+                          active, seeds, sample_idx, temps, top_ps, prefix_idx,
+                          gen_idx, write_idx, g_states, g_flags, *tabs):
+            logits, k_cols, v_cols = paged_verify_step(
+                config, params, cur[:, None], gen_lens, prompt_lens,
+                KVCache(k=pool_k, v=pool_v), prefix_idx, gen_idx,
+                attn_impl=self._paged_attn_impl,
+                page_size=self._pool.page_size,
+            )
+            pool_k = pool_k.at[:, write_idx].set(k_cols.astype(pool_k.dtype))
+            pool_v = pool_v.at[:, write_idx].set(v_cols.astype(pool_v.dtype))
+            logits = _apply_mask(
+                mask_pad(logits[:, 0, :]), g_states, g_flags, tabs
+            )
+            keys = row_keys(seeds, gen_lens + 1, sample_idx)
+            tok, lp = sample_rows(logits, keys, temps, top_ps)
+            tok = jnp.where(active, tok, jnp.int32(pad_id))
+            lp = jnp.where(active, lp, 0.0)
+            return tok, lp, pool_k, pool_v, _advance(tok, g_states, g_flags, tabs)
+
+        fns = {
+            "admit": jax.jit(_admit_g),
+            "step": jax.jit(_step_g, donate_argnums=(2,)),
+            "step_paged": jax.jit(_step_paged_g, donate_argnums=(1, 2)),
+        }
+        self._g_programs = (shape_key, fns)
+        return fns
 
     # -- worker ------------------------------------------------------------
 
@@ -567,12 +722,32 @@ class ContinuousDecodeLoop:
         temps[:n] = temperature
         tps = np.full((W,), 1.0, np.float32)
         tps[:n] = top_p
-        tok0, lp0 = self._admit_sample_fn(
-            fl, jnp.asarray(seeds), jnp.asarray(sidx), jnp.asarray(temps),
-            jnp.asarray(tps),
-        )
-        tok0 = np.asarray(jax.device_get(tok0))[:n]
-        lp0 = np.asarray(jax.device_get(lp0))[:n]
+        if req.grammar is not None:
+            # Constrained admission: mask the first sample from the start
+            # state and advance each row's automaton on device; the states
+            # ride the same readback as tok0/lp0 (admission is not the hot
+            # loop, but there is still only one sync here).
+            self._install_grammar(req.grammar)
+            fns = self._grammar_programs()
+            g_states = np.full((W,), self._dgrammar.start, np.int32)
+            g_flags = np.zeros((W,), bool)
+            g_flags[:n] = True
+            tok0, lp0, st0 = fns["admit"](
+                fl, jnp.asarray(seeds), jnp.asarray(sidx), jnp.asarray(temps),
+                jnp.asarray(tps), jnp.asarray(g_states), jnp.asarray(g_flags),
+                *self._g_tabs(),
+            )
+            tok0, lp0, st0 = map(np.asarray, jax.device_get((tok0, lp0, st0)))
+            tok0, lp0, st0 = tok0[:n], lp0[:n], st0[:n]
+            GRAMMAR_EVENTS.record("grammar.masked_steps", n)
+        else:
+            tok0, lp0 = self._admit_sample_fn(
+                fl, jnp.asarray(seeds), jnp.asarray(sidx), jnp.asarray(temps),
+                jnp.asarray(tps),
+            )
+            tok0 = np.asarray(jax.device_get(tok0))[:n]
+            lp0 = np.asarray(jax.device_get(lp0))[:n]
+            st0 = np.zeros((n,), np.int32)
 
         for j, slot in enumerate(rows):
             self._active[slot] = req
@@ -584,6 +759,8 @@ class ContinuousDecodeLoop:
             self._sample_idx[slot] = j
             self._temps[slot] = temperature
             self._top_ps[slot] = top_p
+            self._g_flags[slot] = req.grammar is not None
+            self._g_states[slot] = st0[j]
             req.tokens.append([int(tok0[j])])
             req.logprobs.append([float(lp0[j])])
             done0 = int(tok0[j]) in self.eos_ids
@@ -738,32 +915,64 @@ class ContinuousDecodeLoop:
             sidx = jnp.asarray(self._sample_idx)
             temps = jnp.asarray(self._temps)
             tps = jnp.asarray(self._top_ps)
+            # Grammar twins run only when a constrained row is live: steps
+            # with no grammar work dispatch the ORIGINAL programs, so the
+            # unconstrained loop stays byte-identical (and program-identical).
+            n_masked = int((self._g_flags & self._active_mask).sum())
+            if n_masked:
+                g_states = jnp.asarray(self._g_states)
+                g_flags = jnp.asarray(self._g_flags)
+                g_fns = self._grammar_programs()
+                g_tabs = self._g_tabs()
             if self.paged:
                 write_idx = jnp.asarray(self._prepare_step_pages())
                 pidx = jnp.asarray(self._prefix_idx)
                 gidx = jnp.asarray(self._gen_idx)
+        new_g = None
         if self.paged:
             pool = self._pool
             note_paged_attn_dispatch(self._paged_attn_impl)
             with pool.lock:
                 note_device_dispatch("continuous paged step")
-                tok, lp, new_k, new_v = self._step_paged_fn(
-                    self.engine.params, pool.kv.k, pool.kv.v, cur, gen_lens,
-                    prompt_lens, active, seeds, sidx, temps, tps, pidx, gidx,
-                    write_idx,
-                )
+                if n_masked:
+                    tok, lp, new_k, new_v, new_g = g_fns["step_paged"](
+                        self.engine.params, pool.kv.k, pool.kv.v, cur,
+                        gen_lens, prompt_lens, active, seeds, sidx, temps,
+                        tps, pidx, gidx, write_idx, g_states, g_flags,
+                        *g_tabs,
+                    )
+                else:
+                    tok, lp, new_k, new_v = self._step_paged_fn(
+                        self.engine.params, pool.kv.k, pool.kv.v, cur,
+                        gen_lens, prompt_lens, active, seeds, sidx, temps,
+                        tps, pidx, gidx, write_idx,
+                    )
                 pool.kv = KVCache(k=new_k, v=new_v)
         else:
             note_device_dispatch("continuous dense step")
-            tok, lp, self._gen = self._step_fn(
-                self.engine.params, self._prefix, self._gen, cur, gen_lens,
-                prompt_lens, active, seeds, sidx, temps, tps,
-            )
+            if n_masked:
+                tok, lp, self._gen, new_g = g_fns["step"](
+                    self.engine.params, self._prefix, self._gen, cur,
+                    gen_lens, prompt_lens, active, seeds, sidx, temps, tps,
+                    g_states, g_flags, *g_tabs,
+                )
+            else:
+                tok, lp, self._gen = self._step_fn(
+                    self.engine.params, self._prefix, self._gen, cur,
+                    gen_lens, prompt_lens, active, seeds, sidx, temps, tps,
+                )
         # The one by-design sync per step: slot bookkeeping below needs the
-        # sampled token ids on the host, and it runs outside both locks.
+        # sampled token ids on the host, and it runs outside both locks
+        # (advanced grammar states ride the same fetch — no extra sync).
         # kllms: ignore[host-sync-hot-path] — the per-step result readback; everything after it is host-side bookkeeping
-        tok_np, lp_np = map(np.asarray, jax.device_get((tok, lp)))
+        fetched = list(map(np.asarray, jax.device_get((tok, lp) if new_g is None else (tok, lp, new_g))))
+        tok_np, lp_np = fetched[0], fetched[1]
         with self._lock:
+            if new_g is not None:
+                # .copy(): device_get may hand back a read-only view, and the
+                # mirror is written per-slot at admission/retirement.
+                self._g_states = fetched[2].copy()
+                GRAMMAR_EVENTS.record("grammar.masked_steps", n_masked)
             self._stats["steps"] += 1
             self._stats["row_steps"] += int(self._active_mask.sum())
             self._stats["max_active_rows"] = max(
@@ -831,6 +1040,8 @@ class ContinuousDecodeLoop:
                 self._active_mask[slot] = False
                 self._cur[slot] = self.engine.config.pad_token_id
                 self._active[slot] = None
+                self._g_flags[slot] = False
+                self._g_states[slot] = 0
                 self._release_slot_pages(slot)
                 self._free.append(slot)
 

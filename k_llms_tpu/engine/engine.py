@@ -120,6 +120,23 @@ def _constraint_ops(constraint):
             token_mask_logits,
             lambda t, tok, state: (token_advance(t, tok, state),),
         )
+    from .grammar import CompiledGrammar
+
+    if isinstance(constraint, CompiledGrammar):
+        from .grammar import (
+            device_grammar,
+            grammar_advance,
+            grammar_initial_state,
+            grammar_mask_logits,
+        )
+
+        jt = device_grammar(constraint)
+        return (
+            jt,
+            lambda n: (grammar_initial_state(jt, n),),
+            grammar_mask_logits,
+            lambda t, tok, state: (grammar_advance(t, tok, state),),
+        )
     from .schema_constraint import (
         device_dfa,
         dfa_advance,
@@ -1345,11 +1362,14 @@ class LocalEngine:
         so a request's samples are reproducible regardless of what it was
         batched with.
         """
+        from .grammar import CompiledGrammar
         from .token_constraint import TokenConstraint
 
         constraint_key = constraint
         if isinstance(constraint, TokenConstraint):
             constraint_key = ("token", constraint.digest)
+        elif isinstance(constraint, CompiledGrammar):
+            constraint_key = ("grammar", constraint.digest)
         elif constraint is not None and constraint != "json":
             constraint_key = ("schema", constraint.digest)
         cache_key = (
@@ -1670,12 +1690,15 @@ class LocalEngine:
         - top_logprobs: captured per verified position from the same
           post-mask logits sampling sees, scattered at the emitted offsets.
         """
+        from .grammar import CompiledGrammar
         from .token_constraint import TokenConstraint
 
         K = self.spec_lookahead
         constraint_key = constraint
         if isinstance(constraint, TokenConstraint):
             constraint_key = ("token", constraint.digest)
+        elif isinstance(constraint, CompiledGrammar):
+            constraint_key = ("grammar", constraint.digest)
         elif constraint is not None and constraint != "json":
             constraint_key = ("schema", constraint.digest)
         cache_key = (
@@ -2242,6 +2265,7 @@ class LocalEngine:
     def _validate_constraint(self, constraint, eos: List[int]) -> None:
         """Reject malformed constraint/eos combinations before any device work
         (prefill compiles take seconds)."""
+        from .grammar import CompiledGrammar
         from .schema_constraint import SchemaDFA
         from .token_constraint import TokenConstraint
 
@@ -2249,13 +2273,13 @@ class LocalEngine:
         if constraint is None:
             return
         if constraint != "json" and not isinstance(
-            constraint, (SchemaDFA, TokenConstraint)
+            constraint, (SchemaDFA, TokenConstraint, CompiledGrammar)
         ):
             raise ValueError(
                 f"Unknown constraint {constraint!r}; supported: 'json', a compiled "
-                "SchemaDFA, or a compiled TokenConstraint"
+                "SchemaDFA, a compiled TokenConstraint, or a CompiledGrammar"
             )
-        if isinstance(constraint, TokenConstraint):
+        if isinstance(constraint, (TokenConstraint, CompiledGrammar)):
             # Token-level masks carry their own vocabulary; the model head must
             # cover it, and eos must be a special (len-0) or out-of-vocab id so
             # opening its column cannot alias a grammar token.
@@ -2268,7 +2292,9 @@ class LocalEngine:
                 0 <= e < constraint.vocab_size and constraint.token_len[e] > 0
                 for e in eos
             ):
-                raise ValueError("eos ids must be special tokens under a TokenConstraint")
+                raise ValueError(
+                    "eos ids must be special tokens under a token-level constraint"
+                )
         else:
             # The byte masks treat token ids 0..255 AS bytes — the caller must
             # use a byte-level tokenizer (TpuBackend gates on is_byte_level).

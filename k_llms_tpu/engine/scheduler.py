@@ -758,6 +758,7 @@ class EngineScheduler:
             }
         self._attach_consensus(out)
         self._attach_kernel(out)
+        self._attach_grammar(out)
         return out
 
     def _attach_consensus(self, out: Dict[str, Any]) -> None:
@@ -781,6 +782,18 @@ class EngineScheduler:
         snap = KERNEL_EVENTS.snapshot()
         if snap:
             out["kernel"] = snap
+
+    def _attach_grammar(self, out: Dict[str, Any]) -> None:
+        """Merge the constrained-decoding counters (process-global
+        GRAMMAR_EVENTS: compiles, cache hits/misses, counted fallbacks,
+        masked decode steps). Omitted until the first grammar event —
+        deployments that never constrain see no grammar section; the backend
+        layers the cache gauges + enabled flag into the same key."""
+        from ..utils.observability import GRAMMAR_EVENTS
+
+        snap = GRAMMAR_EVENTS.snapshot()
+        if snap:
+            out["grammar"] = {"events": snap}
 
     def health(self) -> Dict[str, Any]:
         """Point-in-time lifecycle snapshot, shaped for a /healthz endpoint.
@@ -812,6 +825,7 @@ class EngineScheduler:
             }
         self._attach_consensus(out)
         self._attach_kernel(out)
+        self._attach_grammar(out)
         return out
 
     def drain(self, timeout: float = 30.0) -> bool:

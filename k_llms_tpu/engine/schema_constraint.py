@@ -12,10 +12,11 @@ nesting unrolls into the state chain at compile time. Each schema compiles to
 dense ``trans[S, 256]`` tables (a few hundred states for typical extraction
 schemas); the decode loop indexes them exactly like the generic JSON tables.
 
-Supported: objects (nested, all properties emitted in schema order), string,
-integer, number, boolean, null, Optional/anyOf unions with distinct first
-bytes, string enums (compiled to a shared-prefix trie), arrays of any
-supported element, and const. Unsupported constructs raise
+Supported: objects (nested, all properties emitted in schema order), string
+(plus ``minLength``/``maxLength`` character bounds and the ``date``/``time``/
+``uuid`` formats), integer, number, boolean, null, Optional/anyOf unions with
+distinct first bytes, string enums (compiled to a shared-prefix trie), arrays
+of any supported element, and const. Unsupported constructs raise
 ``SchemaUnsupported`` — the caller falls back to the generic JSON automaton.
 """
 
@@ -114,18 +115,198 @@ class _Builder:
             self.edge(f4, b, c2)
         for b in b'"\\/bfnrt':
             self.edge(esc, b, body)
-        u = [self.new_state() for _ in range(4)]
-        self.edge(esc, ord("u"), u[0])
-        for i in range(4):
-            nxt = body if i == 3 else u[i + 1]
-            for b in b"0123456789abcdefABCDEF":
-                self.edge(u[i], b, nxt)
+        self._u_escape(esc, body)
         return end
+
+    _HEX = b"0123456789abcdefABCDEF"
+
+    def _u_escape(self, esc: int, dst: int) -> None:
+        """``\\uXXXX`` from an escape state, with surrogate hygiene: a lone
+        surrogate is banned (json.loads tolerates one, but the decoded string
+        is unpaired UTF-16 that pydantic — and any strict consumer — rejects);
+        a high surrogate must be completed by a low-surrogate escape, and the
+        whole pair lands on ``dst`` as one character."""
+        u0 = self.new_state()
+        self.edge(esc, ord("u"), u0)
+        u1 = self.new_state()  # first digit not d/D: plain BMP escape
+        s1 = self.new_state()  # first digit d/D: maybe a surrogate
+        u2 = self.new_state()
+        u3 = self.new_state()
+        for b in self._HEX:
+            self.edge(u0, b, s1 if b in b"dD" else u1)
+            self.edge(u1, b, u2)
+            self.edge(u2, b, u3)
+            self.edge(u3, b, dst)
+        for b in b"01234567":  # D0xx-D7xx: still BMP
+            self.edge(s1, b, u2)
+        # D8xx-DBxx: high surrogate — the low half is mandatory.
+        h2, h3 = self.new_state(), self.new_state()
+        p_bs, p_u = self.new_state(), self.new_state()
+        p0, p1, p2, p3 = (self.new_state() for _ in range(4))
+        for b in b"89abAB":
+            self.edge(s1, b, h2)
+        for b in self._HEX:
+            self.edge(h2, b, h3)
+            self.edge(h3, b, p_bs)
+        self.edge(p_bs, 0x5C, p_u)
+        self.edge(p_u, ord("u"), p0)
+        for b in b"dD":
+            self.edge(p0, b, p1)
+        for b in b"cdefCDEF":
+            self.edge(p1, b, p2)
+        for b in self._HEX:
+            self.edge(p2, b, p3)
+            self.edge(p3, b, dst)
+        # DCxx-DFxx first (a lone LOW surrogate): no edge — dead.
 
     def string(self, src: int) -> int:
         quote = self.new_state()
         self.edge(src, 0x22, quote)
         return self.string_body(quote)
+
+    def char_unit(self, src: int, dst: int) -> None:
+        """Wire ``src -> dst`` consuming exactly ONE logical string character:
+        a plain ASCII char, a backslash escape (incl. ``\\uXXXX``), or one
+        complete well-formed UTF-8 multibyte sequence. This is the unit that
+        min/maxLength count (JSON string length is characters, not bytes)."""
+        for b in range(0x20, 0x80):
+            if b not in (0x22, 0x5C):
+                self.edge(src, b, dst)
+        esc = self.new_state()
+        self.edge(src, 0x5C, esc)
+        for b in b'"\\/bfnrt':
+            self.edge(esc, b, dst)
+        self._u_escape(esc, dst)  # surrogate pair = one character
+        # UTF-8 multibyte, same well-formedness windows as string_body.
+        c1 = self.new_state()
+        c2 = self.new_state()
+        c3 = self.new_state()
+        e0 = self.new_state()
+        ed = self.new_state()
+        f0 = self.new_state()
+        f4 = self.new_state()
+        for b in range(0xC2, 0xE0):
+            self.edge(src, b, c1)
+        self.edge(src, 0xE0, e0)
+        for b in [*range(0xE1, 0xED), 0xEE, 0xEF]:
+            self.edge(src, b, c2)
+        self.edge(src, 0xED, ed)
+        self.edge(src, 0xF0, f0)
+        for b in range(0xF1, 0xF4):
+            self.edge(src, b, c3)
+        self.edge(src, 0xF4, f4)
+        for b in range(0x80, 0xC0):
+            self.edge(c1, b, dst)
+            self.edge(c2, b, c1)
+            self.edge(c3, b, c2)
+        for b in range(0xA0, 0xC0):
+            self.edge(e0, b, c1)
+        for b in range(0x80, 0xA0):
+            self.edge(ed, b, c1)
+        for b in range(0x90, 0xC0):
+            self.edge(f0, b, c2)
+        for b in range(0x80, 0x90):
+            self.edge(f4, b, c2)
+
+    _MAX_COUNTED_LEN = 128
+
+    def string_counted(self, src: int, min_len: int, max_len) -> int:
+        """String with character-count bounds, unrolled one char-unit per
+        position. ``max_len=None`` means unbounded above ``min_len`` (the tail
+        loops); a finite bound is capped so the unroll can't explode."""
+        if max_len is not None and max_len > self._MAX_COUNTED_LEN:
+            raise SchemaUnsupported(
+                f"maxLength {max_len} > {self._MAX_COUNTED_LEN} (unroll cap)"
+            )
+        if max_len is not None and min_len > max_len:
+            raise SchemaUnsupported("minLength exceeds maxLength")
+        quote = self.new_state()
+        self.edge(src, 0x22, quote)
+        end = self.new_state()
+        cur = quote
+        if max_len is None:
+            for _ in range(min_len):
+                nxt = self.new_state()
+                self.char_unit(cur, nxt)
+                cur = nxt
+            self.edge(cur, 0x22, end)
+            if min_len:
+                # Past the minimum the tail is a free loop (like string_body).
+                loop = self.new_state()
+                self.char_unit(cur, loop)
+                self.char_unit(loop, loop)
+                self.edge(loop, 0x22, end)
+            else:
+                self.char_unit(cur, cur)
+            return end
+        for i in range(max_len):
+            if i >= min_len:
+                self.edge(cur, 0x22, end)
+            nxt = self.new_state()
+            self.char_unit(cur, nxt)
+            cur = nxt
+        self.edge(cur, 0x22, end)
+        return end
+
+    def _digit_range(self, src: int, dst: int, lo: int, hi: int) -> None:
+        for d in range(lo, hi + 1):
+            self.edge(src, ord("0") + d, dst)
+
+    def formatted_string(self, src: int, fmt: str) -> int:
+        """Lexical shapes for the common pydantic string formats. The mask
+        guarantees the SHAPE (digit ranges included); full calendar validity
+        (leap years, 30-day months) stays with post-hoc model validation."""
+        quote = self.new_state()
+        self.edge(src, 0x22, quote)
+        if fmt == "date":  # YYYY-MM-DD, month 01-12, day 01-31
+            cur = quote
+            for _ in range(4):
+                nxt = self.new_state()
+                self._digit_range(cur, nxt, 0, 9)
+                cur = nxt
+            cur = self.literal(cur, b"-")
+            m0, m1, m_end = self.new_state(), self.new_state(), self.new_state()
+            self.edge(cur, ord("0"), m0)
+            self.edge(cur, ord("1"), m1)
+            self._digit_range(m0, m_end, 1, 9)
+            self._digit_range(m1, m_end, 0, 2)
+            cur = self.literal(m_end, b"-")
+            d0, d12, d3, d_end = (self.new_state() for _ in range(4))
+            self.edge(cur, ord("0"), d0)
+            for b in b"12":
+                self.edge(cur, b, d12)
+            self.edge(cur, ord("3"), d3)
+            self._digit_range(d0, d_end, 1, 9)
+            self._digit_range(d12, d_end, 0, 9)
+            self._digit_range(d3, d_end, 0, 1)
+            return self.close(d_end, b'"')
+        if fmt == "time":  # HH:MM:SS, hour 00-23, min/sec 00-59
+            h01, h2, h_end = self.new_state(), self.new_state(), self.new_state()
+            for b in b"01":
+                self.edge(quote, b, h01)
+            self.edge(quote, ord("2"), h2)
+            self._digit_range(h01, h_end, 0, 9)
+            self._digit_range(h2, h_end, 0, 3)
+            cur = h_end
+            for _ in range(2):
+                cur = self.literal(cur, b":")
+                hi, lo_end = self.new_state(), self.new_state()
+                self._digit_range(cur, hi, 0, 5)
+                self._digit_range(hi, lo_end, 0, 9)
+                cur = lo_end
+            return self.close(cur, b'"')
+        if fmt == "uuid":  # 8-4-4-4-12 hex, either case
+            cur = quote
+            for i, run in enumerate((8, 4, 4, 4, 12)):
+                if i:
+                    cur = self.literal(cur, b"-")
+                for _ in range(run):
+                    nxt = self.new_state()
+                    for b in b"0123456789abcdefABCDEF":
+                        self.edge(cur, b, nxt)
+                    cur = nxt
+            return self.close(cur, b'"')
+        raise SchemaUnsupported(f"unsupported string format {fmt!r}")
 
     def number(self, src: int, integer_only: bool = False) -> int:
         """JSON number; the end state is the ACCEPTING state reached only once
@@ -188,6 +369,13 @@ class _Builder:
                 ends.extend(self.value(src, {**schema, "type": tt}, defs))
             return ends
         if t == "string":
+            fmt = schema.get("format")
+            if fmt is not None:
+                return [self.formatted_string(src, fmt)]
+            min_len = schema.get("minLength")
+            max_len = schema.get("maxLength")
+            if min_len is not None or max_len is not None:
+                return [self.string_counted(src, int(min_len or 0), max_len)]
             return [self.string(src)]
         if t == "integer":
             return self.number(src, integer_only=True)  # type: ignore[return-value]

@@ -24,10 +24,12 @@ Two implementations, one contract:
 
 Selection is ``resolve_paged_attention_impl`` (backed by
 ``BackendConfig.paged_attention_impl``): "xla" | "pallas" | "auto", with an
-automatic COUNTED fallback (``kernel.paged_attn_fallback``) when "pallas" is
-requested but can't run; "auto" choosing XLA off-TPU is the documented CPU
-posture, not a fallback, so it is not counted. The ``ops.paged_attn``
-failpoint forces the fallback branch for drills.
+automatic COUNTED fallback (``kernel.paged_attn_fallback.<reason>``, where
+the suffix names what blocked the kernel: failpoint / softcap /
+sliding_window / platform) when "pallas" is requested but can't run; "auto"
+choosing XLA off-TPU is the documented CPU posture, not a fallback, so it is
+not counted. The ``ops.paged_attn`` failpoint forces the fallback branch for
+drills.
 
 Masking contract (shared with `gather_kv_pages`): out-of-table positions
 point into the trash page; their values are arbitrary-but-finite and every
@@ -64,10 +66,13 @@ def resolve_paged_attention_impl(requested: str, *, config=None) -> str:
     model using attention softcap or sliding windows is outside the kernel's
     support and resolves to "xla". Resolution is host-side and happens once
     per loop/launch build, not per step. An explicit "pallas" request that
-    cannot be honored records ``kernel.paged_attn_fallback``; "auto" picking
-    XLA off-TPU is the expected CPU posture and is NOT counted. The
-    ``ops.paged_attn`` failpoint (action ``fallback``) forces the counted
-    fallback for observability drills.
+    cannot be honored records ``kernel.paged_attn_fallback.<reason>``, where
+    the reason distinguishes config-driven fallbacks (``softcap``,
+    ``sliding_window`` — the model is outside the kernel's support) from
+    environment-driven ones (``platform`` — no TPU) and drills
+    (``failpoint``); "auto" picking XLA off-TPU is the expected CPU posture
+    and is NOT counted. The ``ops.paged_attn`` failpoint (action
+    ``fallback``) forces the counted fallback for observability drills.
     """
     if requested not in PAGED_ATTENTION_IMPLS:
         raise ValueError(
@@ -76,17 +81,20 @@ def resolve_paged_attention_impl(requested: str, *, config=None) -> str:
         )
     spec = _failpoints.fire("ops.paged_attn")
     if spec is not None and spec.action == "fallback":
-        KERNEL_EVENTS.record("kernel.paged_attn_fallback")
+        KERNEL_EVENTS.record("kernel.paged_attn_fallback.failpoint")
         return "xla"
     if requested == "xla":
         return "xla"
-    supported = config is None or (
-        config.attn_softcap is None and config.sliding_window is None
-    )
-    if jax.default_backend() == "tpu" and supported:
+    if config is not None and config.attn_softcap is not None:
+        blocked: Optional[str] = "softcap"
+    elif config is not None and config.sliding_window is not None:
+        blocked = "sliding_window"
+    else:
+        blocked = None
+    if jax.default_backend() == "tpu" and blocked is None:
         return "pallas"
     if requested == "pallas":
-        KERNEL_EVENTS.record("kernel.paged_attn_fallback")
+        KERNEL_EVENTS.record(f"kernel.paged_attn_fallback.{blocked or 'platform'}")
     return "xla"
 
 

@@ -55,9 +55,19 @@ Injection sites wired in this package:
                            (``ops/paged_attention.py``); the ``fallback``
                            action forces the counted degrade from the fused
                            Pallas kernel to the XLA reference (recording
-                           ``kernel.paged_attn_fallback``), exercising the
-                           kernel-unavailable path without leaving the TPU
-                           build
+                           ``kernel.paged_attn_fallback.failpoint``),
+                           exercising the kernel-unavailable path without
+                           leaving the TPU build
+- ``engine.grammar``     — evaluated when ``grammar_for_schema`` resolves a
+                           compiled grammar mask (``engine/grammar.py``); the
+                           ``fallback`` action degrades the request to
+                           unconstrained decode + post-hoc validation
+                           (recording ``grammar.fallback_failpoint``), and a
+                           ``raise`` spec simulates a grammar compile error
+                           (caught in-module, recorded as
+                           ``grammar.fallback_error``) — the contract under
+                           drill is that constrained decoding never errors a
+                           request
 
 Actions (``FailSpec.action``):
 
@@ -91,7 +101,8 @@ Actions (``FailSpec.action``):
 - ``"fallback"``     — no-op at the site itself; the consumer reads the spec
                        and silently degrades to its host/reference path while
                        recording the fallback counters (device consensus ->
-                       host scorer; paged attention -> XLA reference)
+                       host scorer; paged attention -> XLA reference;
+                       grammar mask -> unconstrained + post-hoc validation)
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -107,6 +118,8 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="engine.pages=leak:2"
     KLLMS_FAILPOINTS="consensus.device=fallback:3"
     KLLMS_FAILPOINTS="ops.paged_attn=fallback:2"
+    KLLMS_FAILPOINTS="engine.grammar=fallback:1"
+    KLLMS_FAILPOINTS="engine.grammar=raise:1"
 where the first numeric arg is ``times`` for
 raise/sleep/oom/corrupt/disconnect/fallback specs, ``times[:delay]`` for hang,
 ``kill[:seed]`` for kill_samples/nan, ``kill`` (pages to drop) for leak, and
@@ -140,6 +153,7 @@ SITES = (
     "serving.request",
     "consensus.device",
     "ops.paged_attn",
+    "engine.grammar",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the

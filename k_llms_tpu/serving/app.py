@@ -65,6 +65,7 @@ _COUNTER_GROUPS = (
     ("stream", "STREAM_EVENTS"),
     ("consensus", "CONSENSUS_EVENTS"),
     ("kernel", "KERNEL_EVENTS"),
+    ("grammar", "GRAMMAR_EVENTS"),
 )
 
 
@@ -177,6 +178,16 @@ class ServingApp:
             if "device_consensus" in consensus:
                 lines.append(
                     f"kllms_consensus_device_enabled {int(bool(consensus['device_consensus']))}"
+                )
+            # Grammar-compile cache gauges + the constrained-decoding switch:
+            # one compile per (schema, vocab) fleet-wide, so hits/misses here
+            # are the direct measure of the cache paying for itself.
+            grammar = health.get("grammar") or {}
+            for key, val in sorted((grammar.get("cache") or {}).items()):
+                lines.append(f"kllms_grammar_cache_{key} {val}")
+            if "enabled" in grammar:
+                lines.append(
+                    f"kllms_grammar_enabled {int(bool(grammar['enabled']))}"
                 )
         body = ("\n".join(lines) + "\n").encode()
         _obs.SERVE_EVENTS.record("request.metrics.200")

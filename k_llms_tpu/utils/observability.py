@@ -231,16 +231,41 @@ CONSENSUS_EVENTS = EventCounters(declared=(
 #: Process-wide accelerator-kernel counters (kernel.paged_attn_pallas_dispatch
 #: / kernel.paged_attn_xla_dispatch — which paged-attention implementation a
 #: decode launch or continuous paged step dispatched, recorded host-side per
-#: launch, not per token; kernel.paged_attn_fallback — an explicit "pallas"
-#: request degraded to the XLA reference, whether by the ops.paged_attn
-#: failpoint or an unsupported platform/config; "auto" choosing XLA on CPU is
-#: the documented posture and is NOT counted as a fallback), fed by
-#: ops/paged_attention.py and surfaced via scheduler stats/health and
-#: ``/metrics`` as ``kllms_kernel_*``.
+#: launch, not per token; kernel.paged_attn_fallback.<reason> — an explicit
+#: "pallas" request degraded to the XLA reference, with the reason suffix
+#: naming what blocked it: ``failpoint`` (the ops.paged_attn failpoint),
+#: ``softcap`` / ``sliding_window`` (model config the kernel doesn't cover —
+#: capability-driven), or ``platform`` (no TPU — environment-driven); "auto"
+#: choosing XLA on CPU is the documented posture and is NOT counted as a
+#: fallback), fed by ops/paged_attention.py and surfaced via scheduler
+#: stats/health and ``/metrics`` as ``kllms_kernel_*``.
 KERNEL_EVENTS = EventCounters(declared=(
     "kernel.paged_attn_pallas_dispatch",
     "kernel.paged_attn_xla_dispatch",
-    "kernel.paged_attn_fallback",
+    "kernel.paged_attn_fallback.*",
+))
+
+#: Process-wide constrained-decoding counters (grammar.compile — a schema ×
+#: vocabulary pair was lifted into packed token masks; grammar.hit /
+#: grammar.miss — process-wide TTL-cache traffic (hits are the fleet-sharing
+#: win: ReplicaSet members with the same tokenizer reuse one compile);
+#: grammar.fallback_unsupported — a schema feature the byte-DFA compiler
+#: doesn't cover degraded the mask to the generic JSON grammar, post-hoc
+#: validation stays authoritative; grammar.fallback_failpoint /
+#: grammar.fallback_error — the engine.grammar failpoint or a compile error
+#: degraded the request to unconstrained decode + post-hoc validation;
+#: grammar.masked_steps — decode steps that sampled under a grammar mask,
+#: recorded host-side per generate/step, never inside the jitted loop), fed
+#: by engine/grammar.py and the backends, surfaced via scheduler stats/health
+#: and ``/metrics`` as ``kllms_grammar_*``.
+GRAMMAR_EVENTS = EventCounters(declared=(
+    "grammar.compile",
+    "grammar.hit",
+    "grammar.miss",
+    "grammar.fallback_unsupported",
+    "grammar.fallback_failpoint",
+    "grammar.fallback_error",
+    "grammar.masked_steps",
 ))
 
 #: Process-wide SSE-streaming counters (streams.opened, streams.completed,

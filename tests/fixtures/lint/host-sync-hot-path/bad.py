@@ -24,3 +24,8 @@ def decode_step(arrays, tok):
 def paged_decode_attention_ref(q, tables):
     pages = tables.tolist()  # glob-matched hot function: sync flagged
     return q, pages
+
+
+def grammar_mask_logits(masks, state):
+    rows = masks[state]
+    return np.asarray(rows)  # configured hot (PR 12 grammar op): sync flagged

@@ -25,3 +25,14 @@ def paged_decode_attention_ref(q, pool_k, tables):
 def collect_results(arrays):
     # Not jitted, not configured hot: syncing here is fine.
     return [np.asarray(a) for a in map(jax.device_get, arrays)]
+
+
+def grammar_mask_logits(masks, state, logits):
+    # Configured hot (PR 12 grammar op); the row gather + unpack is pure
+    # device math, no readback.
+    rows = masks[state]
+    return logits + rows
+
+
+def grammar_advance(trans, token, state):
+    return trans[state, token]  # configured hot: pure device gather

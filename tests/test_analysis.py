@@ -106,7 +106,11 @@ def test_dispatch_under_lock_good_fixture_is_clean():
 
 
 HOT_CFG = {
-    "host-sync-hot-path": {"hot_functions": ["decode_step", "paged_*"]}
+    "host-sync-hot-path": {
+        "hot_functions": [
+            "decode_step", "paged_*", "grammar_mask_logits", "grammar_advance",
+        ]
+    }
 }
 
 
@@ -114,9 +118,9 @@ def test_host_sync_bad_fixture_flags_jitted_and_hot_syncs():
     msgs = messages(
         run_fixture("host-sync-hot-path", "host-sync-hot-path/bad.py", HOT_CFG)
     )
-    assert len(msgs) == 4
+    assert len(msgs) == 5
     assert sum("a jitted body" in m for m in msgs) == 1
-    assert sum("a configured hot function" in m for m in msgs) == 3
+    assert sum("a configured hot function" in m for m in msgs) == 4
     assert any("*.item" in m for m in msgs)
     assert any("np.asarray" in m for m in msgs)
     assert any("jax.device_get" in m for m in msgs)
@@ -145,7 +149,9 @@ def test_jit_recompile_bad_fixture():
 
 
 JIT_CFG = {
-    "jit-recompile-hygiene": {"builder_functions": ["_get_decode_loop"]}
+    "jit-recompile-hygiene": {
+        "builder_functions": ["_get_decode_loop", "_grammar_programs"]
+    }
 }
 
 
@@ -161,14 +167,15 @@ def test_jit_recompile_good_fixture_sanctions_every_memoized_pattern():
 
 
 def test_jit_recompile_builder_config_is_load_bearing():
-    # Without the configured builder_functions entry the same fixture must
-    # fire exactly once, on the config-sanctioned builder — proving the
-    # pyproject `_get_decode_loop` entry suppresses a real finding.
+    # Without the configured builder_functions entries the same fixture must
+    # fire on every config-sanctioned builder — proving the pyproject
+    # `_get_decode_loop` / `_grammar_programs` entries suppress real findings.
     msgs = messages(
         run_fixture("jit-recompile-hygiene", "jit-recompile-hygiene/good.py")
     )
-    assert len(msgs) == 1
-    assert "_get_decode_loop" in msgs[0]
+    assert len(msgs) == 2
+    assert any("_get_decode_loop" in m for m in msgs)
+    assert any("_grammar_programs" in m for m in msgs)
 
 
 BAD_FP_TESTS = {

@@ -135,13 +135,14 @@ def test_qualification_bounds(loop):
 
 def test_backend_routes_qualifying_requests_to_loop():
     """TpuBackend with continuous_batching=True serves plain sampling through
-    the slot loop (stats move) but keeps constrained requests on the
-    coalescing scheduler (the loop never sees them)."""
+    the slot loop (stats move); since PR 12 grammar-constrained requests ride
+    the same loop under the fused mask instead of dropping to coalescing."""
     import jax
     from conftest import shared_engine
 
     from k_llms_tpu import KLLMs
     from k_llms_tpu.backends.tpu import TpuBackend
+    from k_llms_tpu.utils.observability import GRAMMAR_EVENTS
 
     engine = (
         shared_engine("tiny", mesh_shape=(8, 1)) if len(jax.devices()) == 8 else None
@@ -158,14 +159,17 @@ def test_backend_routes_qualifying_requests_to_loop():
     assert len(r.choices) == 3
     assert backend._continuous.stats["admitted"] == 1
 
-    # json_object response_format needs the constraint machinery → coalescing
-    # path; the loop's admission count must NOT move.
+    # json_object response_format compiles to the generic-JSON grammar and
+    # rides the loop as a masked request: admission count moves, and every
+    # generated token is a counted masked step.
+    masked_before = GRAMMAR_EVENTS.snapshot().get("grammar.masked_steps", 0)
     r2 = client.chat.completions.create(
         messages=msgs, model="tiny", n=1, seed=9, max_tokens=4,
         response_format={"type": "json_object"},
     )
     assert r2.choices
-    assert backend._continuous.stats["admitted"] == 1
+    assert backend._continuous.stats["admitted"] == 2
+    assert GRAMMAR_EVENTS.snapshot().get("grammar.masked_steps", 0) > masked_before
 
     # health() surfaces the loop; drain() quiesces it and closes admission.
     assert backend.health()["continuous"]["completed"] >= 1

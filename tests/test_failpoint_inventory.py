@@ -36,8 +36,9 @@ def test_ast_registry_matches_runtime_registry():
     assert reg is not None
     sites = FailpointCoverageRule()._sites(reg)
     assert set(sites) == set(SITES)
-    assert len(sites) >= 13
+    assert len(sites) >= 14
     assert "ops.paged_attn" in sites  # PR 11: paged-attention kernel drill
+    assert "engine.grammar" in sites  # PR 12: constrained-decoding drill
     for site in sites:
         sub, _, name = site.partition(".")
         assert sub and name, f"site {site!r} must be subsystem.name"

@@ -386,13 +386,31 @@ def test_resolve_cpu_posture_counts_only_unsatisfied_pallas():
     assert resolve_paged_attention_impl("xla") == "xla"
     mid = _snap()
     # "auto" -> xla off-TPU is the documented CPU posture, NOT a fallback.
-    assert _delta(before, mid, "kernel.paged_attn_fallback") == 0
-    # An explicit "pallas" that cannot run is a COUNTED degradation.
+    assert all(_delta(before, mid, k) == 0 for k in mid if "fallback" in k)
+    # An explicit "pallas" that cannot run is a COUNTED degradation, keyed
+    # by reason: off-TPU with a supported config, the reason is the platform.
     assert resolve_paged_attention_impl("pallas") == "xla"
-    assert _delta(mid, _snap(), "kernel.paged_attn_fallback") == 1
+    assert _delta(mid, _snap(), "kernel.paged_attn_fallback.platform") == 1
     with pytest.raises(ValueError):
         resolve_paged_attention_impl("flash")
     assert set(PAGED_ATTENTION_IMPLS) == {"auto", "pallas", "xla"}
+
+
+def test_resolve_names_the_unsupported_feature_in_the_fallback_key():
+    """Config-driven fallbacks are distinguishable from platform ones on
+    /metrics: softcap and sliding-window models record their own reason
+    suffix, and the config reason wins over the platform reason."""
+    import dataclasses
+
+    before = _snap()
+    softcap = dataclasses.replace(CONFIG, attn_softcap=30.0)
+    assert resolve_paged_attention_impl("pallas", config=softcap) == "xla"
+    sliding = dataclasses.replace(CONFIG, sliding_window=128)
+    assert resolve_paged_attention_impl("pallas", config=sliding) == "xla"
+    after = _snap()
+    assert _delta(before, after, "kernel.paged_attn_fallback.softcap") == 1
+    assert _delta(before, after, "kernel.paged_attn_fallback.sliding_window") == 1
+    assert _delta(before, after, "kernel.paged_attn_fallback.platform") == 0
 
 
 def test_ops_paged_attn_failpoint_forces_counted_fallback():
@@ -405,7 +423,7 @@ def test_ops_paged_attn_failpoint_forces_counted_fallback():
         assert resolve_paged_attention_impl("auto") == "xla"  # fired (2)
         assert resolve_paged_attention_impl("auto") == "xla"  # exhausted
     after = _snap()
-    assert _delta(before, after, "kernel.paged_attn_fallback") == 2
+    assert _delta(before, after, "kernel.paged_attn_fallback.failpoint") == 2
 
 
 def test_ops_paged_attn_env_syntax_parses():
@@ -413,7 +431,7 @@ def test_ops_paged_attn_env_syntax_parses():
     try:
         before = _snap()
         assert resolve_paged_attention_impl("auto") == "xla"
-        assert _delta(before, _snap(), "kernel.paged_attn_fallback") == 1
+        assert _delta(before, _snap(), "kernel.paged_attn_fallback.failpoint") == 1
     finally:
         fp.clear()
 

@@ -93,6 +93,117 @@ def test_unsupported_falls_through():
         compile_schema({"type": "object"})  # free-form object
 
 
+def _one_field(**value_schema):
+    return {
+        "type": "object",
+        "properties": {"v": value_schema},
+        "required": ["v"],
+        "additionalProperties": False,
+    }
+
+
+def _accepts(dfa, doc: bytes) -> bool:
+    ok, complete = validate_bytes(dfa, doc)
+    return ok and complete
+
+
+def test_anyof_union_arms_with_distinct_first_bytes():
+    dfa = compile_schema(
+        _one_field(anyOf=[{"type": "integer"}, {"type": "string"},
+                          {"type": "boolean"}, {"type": "null"}])
+    )
+    for doc in (b'{"v":12}', b'{"v":"x"}', b'{"v":true}', b'{"v":null}'):
+        assert _accepts(dfa, doc), doc
+    assert not _accepts(dfa, b'{"v":[1]}')
+    # Arms that share a first byte are ambiguous -> unsupported, not wrong.
+    with pytest.raises(SchemaUnsupported):
+        compile_schema(_one_field(anyOf=[{"type": "integer"},
+                                         {"type": "number"}]))
+
+
+def test_nested_arrays_of_objects():
+    inner = {
+        "type": "object",
+        "properties": {"id": {"type": "integer"},
+                       "tags": {"type": "array", "items": {"type": "string"}}},
+        "required": ["id", "tags"],
+        "additionalProperties": False,
+    }
+    dfa = compile_schema(_one_field(type="array", items=inner))
+    for doc in (
+        b'{"v":[]}',
+        b'{"v":[{"id":1,"tags":[]}]}',
+        b'{"v":[{"id":1,"tags":["a","b"]},{"id":2,"tags":["c"]}]}',
+    ):
+        assert _accepts(dfa, doc), doc
+        json.loads(doc)
+    assert not _accepts(dfa, b'{"v":[{"tags":[],"id":1}]}')  # key order
+    assert not _accepts(dfa, b'{"v":[{"id":1}]}')  # missing nested key
+
+
+def test_integer_vs_number_token_boundaries():
+    int_dfa = compile_schema(_one_field(type="integer"))
+    num_dfa = compile_schema(_one_field(type="number"))
+    for doc in (b'{"v":0}', b'{"v":-7}', b'{"v":123}'):
+        assert _accepts(int_dfa, doc) and _accepts(num_dfa, doc), doc
+    for doc in (b'{"v":1.5}', b'{"v":-0.25}', b'{"v":3e2}', b'{"v":1E-4}'):
+        assert not validate_bytes(int_dfa, doc)[0], doc  # '.'/'e' dead for int
+        assert _accepts(num_dfa, doc), doc
+    for doc in (b'{"v":01}', b'{"v":.5}', b'{"v":1.}', b'{"v":-}'):
+        assert not _accepts(int_dfa, doc) and not _accepts(num_dfa, doc), doc
+
+
+def test_string_length_bounds_count_characters():
+    dfa = compile_schema(_one_field(type="string", minLength=2, maxLength=4))
+    for doc in (b'{"v":"ab"}', b'{"v":"abcd"}', b'{"v":"a\\nb"}',
+                '{"v":"héj"}'.encode(), b'{"v":"a\\u00e9"}'):
+        assert _accepts(dfa, doc), doc
+        assert 2 <= len(json.loads(doc)["v"]) <= 4
+    for doc in (b'{"v":""}', b'{"v":"a"}', b'{"v":"abcde"}'):
+        assert not _accepts(dfa, doc), doc
+    # min-only: the tail is unbounded.
+    open_dfa = compile_schema(_one_field(type="string", minLength=3))
+    assert _accepts(open_dfa, b'{"v":"abcdefghij"}')
+    assert not _accepts(open_dfa, b'{"v":"ab"}')
+    # Bounds past the unroll cap degrade rather than explode.
+    with pytest.raises(SchemaUnsupported):
+        compile_schema(_one_field(type="string", maxLength=4096))
+
+
+def test_unicode_escape_surrogate_hygiene():
+    """json.loads tolerates a lone \\uD8xx surrogate but the decoded string
+    is unpaired UTF-16 that pydantic rejects — the DFA must ban lone
+    surrogates and demand the full pair, or masked samples could complete
+    without validating."""
+    dfa = compile_schema(_one_field(type="string"))
+    assert _accepts(dfa, b'{"v":"\\u00e9"}')        # plain BMP escape
+    assert _accepts(dfa, b'{"v":"\\ud7ff"}')        # below the surrogate gap
+    assert _accepts(dfa, b'{"v":"\\uD83D\\uDE00"}')  # full pair (one char)
+    assert not _accepts(dfa, b'{"v":"\\uDcf7"}')     # lone low surrogate
+    assert not _accepts(dfa, b'{"v":"\\uD83Dx"}')    # high without its pair
+    assert not _accepts(dfa, b'{"v":"\\uD83D\\n"}')  # pair broken by escape
+    # Character counting: the pair is ONE char against length bounds.
+    one = compile_schema(_one_field(type="string", minLength=1, maxLength=1))
+    assert _accepts(one, b'{"v":"\\uD83D\\uDE00"}')
+    assert len(json.loads(b'{"v":"\\uD83D\\uDE00"}')["v"]) == 1
+
+
+def test_string_formats_constrain_shape():
+    date = compile_schema(_one_field(type="string", format="date"))
+    assert _accepts(date, b'{"v":"2026-08-05"}')
+    for doc in (b'{"v":"2026-13-01"}', b'{"v":"2026-00-01"}',
+                b'{"v":"2026-01-32"}', b'{"v":"26-01-01"}'):
+        assert not _accepts(date, doc), doc
+    time_ = compile_schema(_one_field(type="string", format="time"))
+    assert _accepts(time_, b'{"v":"23:59:59"}')
+    assert not _accepts(time_, b'{"v":"24:00:00"}')
+    uuid = compile_schema(_one_field(type="string", format="uuid"))
+    assert _accepts(uuid, b'{"v":"123e4567-e89b-12d3-a456-426614174000"}')
+    assert not _accepts(uuid, b'{"v":"123e4567-e89b-12d3-a456"}')
+    with pytest.raises(SchemaUnsupported):
+        compile_schema(_one_field(type="string", format="email"))
+
+
 def test_device_matches_host_oracle():
     dfa = compile_schema(Invoice.model_json_schema())
     d = device_dfa(dfa)
@@ -157,14 +268,30 @@ def test_parse_end_to_end_all_samples_validate():
             assert isinstance(choice.message.parsed.count, int)
 
 
-def test_backend_falls_back_to_json_for_unsupported():
+def test_backend_constraint_for_compiles_grammars():
     from k_llms_tpu.backends.tpu import TpuBackend
+    from k_llms_tpu.engine.grammar import CompiledGrammar, clear_grammar_cache
 
+    clear_grammar_cache()
     backend = TpuBackend(model="tiny")
-    # dict/object response_format without properties -> generic JSON automaton.
-    assert backend._constraint_for({"type": "json_object"}) == "json"
+    # json_object (no schema) -> the generic-JSON product grammar.
+    generic = backend._constraint_for({"type": "json_object"})
+    assert isinstance(generic, CompiledGrammar)
+    assert generic.digest.startswith("grammar-json-")
     assert backend._constraint_for(None) is None
-    dfa = backend._constraint_for(Invoice)
-    assert dfa is not None and dfa != "json"
-    # Cached on second call (same object identity).
-    assert backend._constraint_for(Invoice) is dfa
+    g = backend._constraint_for(Invoice)
+    assert isinstance(g, CompiledGrammar)
+    assert g.digest != generic.digest
+    # The process-wide TTL cache makes the second call a hit (same object).
+    assert backend._constraint_for(Invoice) is g
+
+
+def test_backend_constraint_for_respects_config_switch():
+    from k_llms_tpu.backends.tpu import BackendConfig, TpuBackend
+
+    backend = TpuBackend(
+        model="tiny", config=BackendConfig(model="tiny", constrained_decoding=False)
+    )
+    # Switch off: requests decode unconstrained, post-hoc validation only.
+    assert backend._constraint_for(Invoice) is None
+    assert backend._constraint_for({"type": "json_object"}) is None
