@@ -132,6 +132,11 @@ class BackendConfig(BaseModel):
     hbm_headroom: float = 0.85
     # Default timeout for drain()/close() graceful shutdown.
     drain_timeout: float = 30.0
+    # SSE keep-alive: the serving layer emits a ``: ping`` comment frame on
+    # streaming responses whenever this many seconds pass without a data
+    # event (admission queue wait, long prefill), so idle-timeout proxies
+    # don't sever the connection before the first token. 0 disables.
+    sse_ping_interval_s: float = 15.0
     # -- self-healing supervision (PR 4) ----------------------------------
     # Hung-launch watchdog budget: clamp(base + multiplier * max_new_tokens
     # * per-token EWMA) seconds per device launch. The generous min floor
@@ -552,6 +557,12 @@ class TpuBackend(Backend):
                     cfg.continuous_max_prompt + cfg.continuous_max_new
                 ),
             )
+        # The loop gets its OWN budget model: per-step EWMA latency (one
+        # decode step each observation) must not pollute the supervisor's
+        # per-launch EWMA (whole coalesced decodes), and vice versa. Same
+        # clamp envelope, independent learned state.
+        from ..reliability.supervisor import LaunchBudgetModel
+
         return ContinuousDecodeLoop(
             self.engine,
             width=max(1, width),
@@ -559,6 +570,18 @@ class TpuBackend(Backend):
             max_new=cfg.continuous_max_new,
             eos_ids=self.tokenizer.stop_ids,
             admission_gate=self.scheduler.admission_error,
+            budget_model=LaunchBudgetModel(
+                base_s=cfg.watchdog_base_s,
+                per_token_s=cfg.watchdog_per_token_s,
+                multiplier=cfg.watchdog_multiplier,
+                min_budget_s=cfg.watchdog_min_budget_s,
+                max_budget_s=cfg.watchdog_max_budget_s,
+            ),
+            rebuild_fn=self._rebuild_loop_engine,
+            max_rebuilds=cfg.max_rebuilds,
+            on_recovering=self.scheduler.note_recovering,
+            on_rebuilt=self.scheduler.note_rebuilt,
+            on_rebuild_failed=self.scheduler.note_rebuild_failed,
         )
 
     # -- engine lifecycle --------------------------------------------------
@@ -623,20 +646,21 @@ class TpuBackend(Backend):
         self.engine = self._build_engine()
         self._wire_engine_hooks()
         if self._continuous is not None:
-            # The loop holds device KV tied to the wedged engine's params —
-            # fail its in-flight work (callers see the same typed 503 a
-            # mid-rebuild coalesced launch gets) and stand up a fresh loop
-            # bound to the new engine.
-            from ..types.wire import BackendUnavailableError
+            # The loop holds device KV tied to the old engine's params. Hand
+            # it the new engine: the loop journals its in-flight rows,
+            # re-prefills against the fresh weights, and replays each
+            # survivor byte-identically (pinned seeds + self-deterministic
+            # row keys) — callers keep streaming instead of eating a 503.
+            self._continuous.adopt_engine(self.engine)
 
-            old = self._continuous
-            old._fail_all(
-                BackendUnavailableError(
-                    "engine rebuilt mid-decode; retry the request"
-                )
-            )
-            old.stop()
-            self._continuous = self._build_continuous_loop()
+    def _rebuild_loop_engine(self) -> LocalEngine:
+        """Continuous-loop rebuild_fn: same reload as the supervisor path
+        (checkpoint integrity re-verified, fresh jit caches, hooks rewired),
+        but DRIVEN by the loop — it already holds its own journal, so this
+        just returns the engine for the loop to adopt in place."""
+        self.engine = self._build_engine()
+        self._wire_engine_hooks()
+        return self.engine
 
     # -- chat -------------------------------------------------------------
     supports_streaming = True

@@ -51,6 +51,7 @@ resident one falls back to coalescing instead of fragmenting the loop.
 from __future__ import annotations
 
 import logging
+import queue as _queue_mod
 import threading
 import time
 from collections import deque
@@ -68,17 +69,41 @@ from ..models.llama import KVCache, init_cache, paged_verify_step, verify_step
 from ..ops.paged_attention import note_paged_attn_dispatch
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
-from ..types.wire import BackendUnavailableError, ServerDrainingError
-from ..utils.observability import FAILURE_EVENTS, GRAMMAR_EVENTS
-from .engine import GenerationResult, is_resource_exhausted
-from .paging import TRASH_PAGE, PagePoolExhausted, flat_slots, pages_for
+from ..types.wire import (
+    BackendUnavailableError,
+    CheckpointCorruptError,
+    EngineHungError,
+    ServerDrainingError,
+)
+from ..utils.observability import FAILURE_EVENTS, GRAMMAR_EVENTS, RECOVERY_EVENTS
+from .engine import (
+    GenerationResult,
+    _poisoned_logits,
+    _quarantine_error,
+    is_resource_exhausted,
+)
+from .paging import (
+    TRASH_PAGE,
+    PageAccountingError,
+    PagePoolExhausted,
+    flat_slots,
+    pages_for,
+)
 
 logger = logging.getLogger(__name__)
 
 
 @dataclass
 class _SlotRequest:
-    """Host-side record of one admitted request and its slot rows."""
+    """Host-side record of one admitted request and its slot rows.
+
+    The journal fields (``ids`` / ``seed`` / ``temperature`` / ``top_p``,
+    plus ``grammar``) are everything recovery needs to re-admit the request
+    after an engine rebuild: row keys derive only from (seed, step,
+    sample_idx), so replaying from the original prompt regenerates the same
+    token stream byte-for-byte — ``delivered_watermark`` then suppresses the
+    already-delivered prefix so streaming sinks see contiguous bytes exactly
+    once."""
 
     future: Future
     prompt_len: int
@@ -86,6 +111,13 @@ class _SlotRequest:
     max_new: int
     budget: Optional[RequestBudget]
     token_sink: Optional[Callable[[int, np.ndarray], None]]
+    # Replay journal: the canonical prompt tokens and admission-pinned
+    # sampling parameters, recorded at submit before any device work.
+    ids: List[int]
+    seed: int
+    temperature: float
+    top_p: float
+    seq: int
     # CompiledGrammar when the request decodes under a schema mask; the loop
     # holds ONE resident grammar's tables on device, so a different-digest
     # request is rejected at submit (the backend reroutes it to coalescing).
@@ -96,7 +128,107 @@ class _SlotRequest:
     logprobs: List[List[float]] = field(default_factory=list)
     done: List[bool] = field(default_factory=list)
     finish: List[str] = field(default_factory=list)
+    sample_errors: List[Optional[Dict[str, Any]]] = field(default_factory=list)
     steps_delivered: int = 0
+    # Sink steps already delivered before the last fault: replayed steps
+    # below this watermark are regenerated (the device needs them) but NOT
+    # re-delivered.
+    delivered_watermark: int = 0
+    replays: int = 0
+
+
+class _StepHung(RuntimeError):
+    """Internal: a step dispatch overran its watchdog budget."""
+
+
+class _StaleStep(RuntimeError):
+    """Internal: an abandoned step thread woke into a newer loop epoch."""
+
+
+class _PoolFault(RuntimeError):
+    """Internal: page accounting failed; the pool must be quarantined."""
+
+
+class _AdoptEngine(Exception):
+    """Internal: an externally rebuilt engine is waiting to be adopted."""
+
+    def __init__(self, engine: Any) -> None:
+        super().__init__("adopt rebuilt engine")
+        self.engine = engine
+
+
+class _StepDispatcher:
+    """Persistent dispatch thread the loop worker hands each device step to.
+
+    The worker waits on the step's completion event under the watchdog
+    budget; an overdue step is ABANDONED — its ticket is fenced, the inbox
+    and thread are retired, and a fresh pair serves subsequent steps — so a
+    wedged device dispatch blocks one disposable thread, never the loop.
+    Hand-off uses a plain ``queue.Queue`` (no loop-ordered locks) and the
+    thread is lazily (re)spawned, so the healthy path costs one put/get and
+    one Event wait per step."""
+
+    def __init__(self) -> None:
+        self._inbox: "_queue_mod.Queue" = _queue_mod.Queue()
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._serve,
+                args=(self._inbox,),
+                name="kllms-continuous-step",
+                daemon=True,
+            )
+            self._thread.start()
+
+    @staticmethod
+    def _serve(inbox: "_queue_mod.Queue") -> None:
+        while True:
+            item = inbox.get()
+            if item is None:
+                return
+            fn, ticket = item
+            try:
+                ticket["result"] = fn()
+            except BaseException as exc:
+                ticket["error"] = exc
+            finally:
+                if ticket["abandoned"]:
+                    RECOVERY_EVENTS.record("continuous.stale_steps_discarded")
+                    logger.warning(
+                        "discarding stale result from an abandoned "
+                        "continuous step"
+                    )
+                ticket["done"].set()
+
+    def run(self, fn: Callable[[], Any], budget_s: float) -> Any:
+        """Run ``fn`` on the dispatch thread under a wall-clock budget.
+        Returns its result, re-raises its error, or raises :class:`_StepHung`
+        after abandoning the thread."""
+        self._ensure()
+        ticket: Dict[str, Any] = {
+            "done": threading.Event(),
+            "result": None,
+            "error": None,
+            "abandoned": False,
+        }
+        self._inbox.put((fn, ticket))
+        if ticket["done"].wait(budget_s):
+            if ticket["error"] is not None:
+                raise ticket["error"]
+            return ticket["result"]
+        ticket["abandoned"] = True
+        # Retire the inbox+thread pair: the sentinel makes the stale thread
+        # exit once the hung dispatch finally returns, and the fresh pair
+        # serves the rebuilt loop.
+        self._inbox.put(None)
+        self._inbox = _queue_mod.Queue()
+        self._thread = None
+        raise _StepHung(f"continuous step exceeded its {budget_s:.2f}s budget")
+
+    def close(self) -> None:
+        self._inbox.put(None)
 
 
 class ContinuousDecodeLoop:
@@ -116,6 +248,12 @@ class ContinuousDecodeLoop:
         max_new: int,
         eos_ids: Optional[List[int]] = None,
         admission_gate: Optional[Callable[[], Optional[BaseException]]] = None,
+        budget_model: Optional[Any] = None,
+        rebuild_fn: Optional[Callable[[], Any]] = None,
+        max_rebuilds: int = 2,
+        on_recovering: Optional[Callable[[int, str], None]] = None,
+        on_rebuilt: Optional[Callable[[], None]] = None,
+        on_rebuild_failed: Optional[Callable[[BaseException], None]] = None,
     ) -> None:
         self.engine = engine
         self.width = int(width)
@@ -123,12 +261,34 @@ class ContinuousDecodeLoop:
         self.max_new = int(max_new)
         self.eos_ids = list(eos_ids or [engine.config.eos_token_id])
         self._admission_gate = admission_gate
+        # Self-healing wiring (all optional — a bare loop without a budget
+        # model dispatches steps inline with no watchdog, byte-identically to
+        # the unsupervised loop). ``budget_model`` is the loop's OWN
+        # LaunchBudgetModel: its per-step EWMA must not pollute the coalesced
+        # path's per-launch timings. ``rebuild_fn`` rebuilds and returns a
+        # fresh engine after a hung step or a quarantined page pool.
+        self.budget_model = budget_model
+        self.rebuild_fn = rebuild_fn
+        self.max_rebuilds = int(max_rebuilds)
+        self.on_recovering = on_recovering
+        self.on_rebuilt = on_rebuilt
+        self.on_rebuild_failed = on_rebuild_failed
+        self._dispatcher = _StepDispatcher()
+        # Epoch fence: bumped on every recovery; an abandoned step thread
+        # waking into a newer epoch discards its work instead of committing
+        # device state that belongs to a torn-down engine.
+        self._loop_epoch = 0
+        self._consecutive_faults = 0
+        self._last_recovery_reason: Optional[str] = None
+        self._terminal_error: Optional[BaseException] = None
+        self._pool_fault: Optional[str] = None
+        self._adopted_engine: Optional[Any] = None
+        self._seq = 0
         # The loop Condition is held across admission prefill and the step
         # dispatch on purpose: one decode thread owns the device, and slot
         # state must mutate atomically with the arrays it indexes.
         self._lock = make_condition("engine.continuous", allow_dispatch=True)
         self._queue: "deque[_SlotRequest]" = deque()
-        self._pending_prefill: Dict[int, Any] = {}
         self._active: List[Optional[_SlotRequest]] = [None] * self.width
         self._free: List[int] = list(range(self.width))
         self._closing = False
@@ -194,6 +354,9 @@ class ContinuousDecodeLoop:
             "completed": 0,
             "aborted": 0,
             "max_active_rows": 0,
+            "restarts": 0,
+            "replayed_rows": 0,
+            "quarantined_rows": 0,
         }
         self._thread: Optional[threading.Thread] = None
 
@@ -210,21 +373,53 @@ class ContinuousDecodeLoop:
     def stats(self) -> Dict[str, Any]:
         """Loop counters — and, in paged mode, the page-pool snapshot behind a
         conservation-invariant check (:meth:`PageAllocator.verify`): every
-        ``health()`` read doubles as a fail-fast page-accounting audit, so a
-        leaked or double-freed page surfaces at the next poll instead of as
-        silent corruption."""
+        ``health()`` read doubles as a fail-fast page-accounting audit. A
+        failed audit no longer poisons every subsequent poll: the pool is
+        QUARANTINED (flagged for the worker, which rebuilds the engine and
+        replays the journal) and the fault is reported as data instead of an
+        exception."""
         out = dict(self._stats)
-        if self.paged and self._pool is not None:
-            with self._lock:
-                self._pool.allocator.verify()
-                held = sum(len(t) for t in self._tables) + sum(
-                    len(r) for r in self._reserved
-                )
-                out["pages"] = {
-                    **self._pool.allocator.snapshot(),
-                    "loop_refs": held,
-                }
+        with self._lock:
+            out["width"] = self.width
+            out["free_slots"] = len(self._free)
+            active_rows = int(self._active_mask.sum())
+            out["active_rows"] = active_rows
+            out["occupancy"] = active_rows / self.width if self.width else 0.0
+            out["queue_depth"] = len(self._queue)
+            out["last_recovery_reason"] = self._last_recovery_reason
+            if self.paged and self._pool is not None:
+                if self._pool_fault is None:
+                    fault = self._pool.allocator.check()
+                    if fault is None:
+                        held = sum(len(t) for t in self._tables) + sum(
+                            len(r) for r in self._reserved
+                        )
+                        out["pages"] = {
+                            **self._pool.allocator.snapshot(),
+                            "loop_refs": held,
+                        }
+                    else:
+                        self._quarantine_pool_locked(fault)
+                if self._pool_fault is not None:
+                    out["pages"] = {
+                        "quarantined": True,
+                        "error": self._pool_fault,
+                    }
         return out
+
+    def _quarantine_pool_locked(self, fault: str) -> None:
+        """Flag a page-accounting fault for the worker (lock held). The next
+        worker iteration tears the pool down with the engine and replays the
+        journal instead of letting every health poll keep tripping over the
+        same corrupted allocator."""
+        if self._pool_fault is not None:
+            return
+        self._pool_fault = fault
+        RECOVERY_EVENTS.record("continuous.pool_quarantined")
+        logger.error("continuous loop page pool quarantined: %s", fault)
+        if not self._stopped:
+            self._ensure_worker()
+        self._lock.notify_all()
 
     # -- public API --------------------------------------------------------
 
@@ -272,6 +467,8 @@ class ContinuousDecodeLoop:
             if err is not None:
                 raise err
         with self._lock:
+            if self._terminal_error is not None:
+                raise self._terminal_error
             if self._closing or self._stopped:
                 raise ServerDrainingError(
                     "continuous decode loop is draining; retry against "
@@ -296,24 +493,27 @@ class ContinuousDecodeLoop:
                 f"exceeds loop bounds (W={self.width}, P={self.max_prompt}, "
                 f"G={self.max_new})"
             )
-        req = _SlotRequest(
-            future=Future(),
-            prompt_len=prompt_len,
-            n=max(1, n),
-            max_new=max_new,
-            budget=budget,
-            token_sink=token_sink,
-            grammar=grammar,
-        )
         with self._lock:
             if grammar is not None and self._grammar_busy_locked(grammar):
                 raise ValueError(
                     "continuous loop is decoding under a different grammar; "
                     "take the per-constraint coalescing path"
                 )
-            self._pending_prefill[id(req)] = (ids, prompt_len, seed,
-                                              float(temperature),
-                                              1.0 if top_p is None else float(top_p))
+            req = _SlotRequest(
+                future=Future(),
+                prompt_len=prompt_len,
+                n=max(1, n),
+                max_new=max_new,
+                budget=budget,
+                token_sink=token_sink,
+                ids=list(ids),
+                seed=int(seed),
+                temperature=float(temperature),
+                top_p=1.0 if top_p is None else float(top_p),
+                seq=self._seq,
+                grammar=grammar,
+            )
+            self._seq += 1
             self._queue.append(req)
             self._ensure_worker()
             self._lock.notify_all()
@@ -386,7 +586,12 @@ class ContinuousDecodeLoop:
         def _sample_rows(logits, keys, temps, top_ps):
             # Per-row temperature/top_p (the whole point of the shared loop);
             # same sanitization + untempered-logprob contract as sample_logits.
+            # ``bad`` is the numeric-quarantine verdict, taken on the raw
+            # logits BEFORE sanitization: a poisoned row still samples (the
+            # sanitized path keeps the batch marching) but the host freezes
+            # and retires it with sample_error code "numeric_poison".
             V = logits.shape[-1]
+            bad = _poisoned_logits(logits)
             finite = jnp.isfinite(logits)
             row_ok = jnp.any(finite, axis=-1, keepdims=True)
             logits = jnp.where(finite, logits, -jnp.inf)
@@ -407,7 +612,7 @@ class ContinuousDecodeLoop:
             greedy = jnp.argmax(scaled, axis=-1)
             tok = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
             lp = jnp.take_along_axis(model_lps, tok[:, None], axis=-1)[:, 0]
-            return tok, lp
+            return tok, lp, bad
 
         def _mask_pad(logits):
             if pad_sampleable:
@@ -415,18 +620,19 @@ class ContinuousDecodeLoop:
             return logits.at[:, pad_id].set(-jnp.inf)
 
         def _step(params, prefix, gen, cur, gen_lens, prompt_lens, active,
-                  seeds, sample_idx, temps, top_ps):
+                  seeds, sample_idx, temps, top_ps, poison):
             # One token for all W slots: write cur's KV at each row's own
             # offset (gen_lens), attend row-local prefix + generated KV.
             logits, gen = verify_step(
                 config, params, cur[:, None], gen_lens, prompt_lens, gen, prefix
             )
             logits = _mask_pad(logits[:, 0, :])
+            logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
             keys = _row_keys(seeds, gen_lens + 1, sample_idx)
-            tok, lp = _sample_rows(logits, keys, temps, top_ps)
+            tok, lp, bad = _sample_rows(logits, keys, temps, top_ps)
             tok = jnp.where(active, tok, jnp.int32(pad_id))
             lp = jnp.where(active, lp, 0.0)
-            return tok, lp, gen
+            return tok, lp, bad & active, gen
 
         # gen KV is donated: the loop is its only owner and it is re-passed
         # every step, so the update happens in place on device.
@@ -443,6 +649,9 @@ class ContinuousDecodeLoop:
         def _admit_sample(first_logits, seeds, sample_idx, temps, top_ps):
             # First token, sampled at admission from the prefill logits at
             # step 0 — padded to W rows so every admission shares one program.
+            # Detection-only quarantine here (no injection arg: the
+            # ``engine.logits`` failpoint targets decode steps); genuinely
+            # poisoned prefill logits still freeze the row at step 0.
             keys = _row_keys(seeds, jnp.zeros_like(sample_idx), sample_idx)
             return _sample_rows(_mask_pad(first_logits), keys, temps, top_ps)
 
@@ -450,7 +659,7 @@ class ContinuousDecodeLoop:
 
         def _step_paged(params, pool_k, pool_v, cur, gen_lens, prompt_lens,
                         active, seeds, sample_idx, temps, top_ps, prefix_idx,
-                        gen_idx, write_idx):
+                        gen_idx, write_idx, poison):
             # Paged twin of _step: rows read their KV through block-table
             # gathers into the shared pool and write cur's column back at a
             # host-computed flat slot. Same masks, same sampler, same key
@@ -464,11 +673,12 @@ class ContinuousDecodeLoop:
             pool_k = pool_k.at[:, write_idx].set(k_cols.astype(pool_k.dtype))
             pool_v = pool_v.at[:, write_idx].set(v_cols.astype(pool_v.dtype))
             logits = _mask_pad(logits[:, 0, :])
+            logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
             keys = _row_keys(seeds, gen_lens + 1, sample_idx)
-            tok, lp = _sample_rows(logits, keys, temps, top_ps)
+            tok, lp, bad = _sample_rows(logits, keys, temps, top_ps)
             tok = jnp.where(active, tok, jnp.int32(pad_id))
             lp = jnp.where(active, lp, 0.0)
-            return tok, lp, pool_k, pool_v
+            return tok, lp, bad & active, pool_k, pool_v
 
         self._step_paged_fn = jax.jit(_step_paged, donate_argnums=(1, 2))
         # Raw sampler pieces, reused by the grammar-twin programs so masked
@@ -545,26 +755,30 @@ class ContinuousDecodeLoop:
                      g_states, g_flags, *tabs):
             logits = _apply_mask(mask_pad(first_logits), g_states, g_flags, tabs)
             keys = row_keys(seeds, jnp.zeros_like(sample_idx), sample_idx)
-            tok, lp = sample_rows(logits, keys, temps, top_ps)
-            return tok, lp, _advance(tok, g_states, g_flags, tabs)
+            tok, lp, bad = sample_rows(logits, keys, temps, top_ps)
+            return tok, lp, bad, _advance(tok, g_states, g_flags, tabs)
 
         def _step_g(params, prefix, gen, cur, gen_lens, prompt_lens, active,
-                    seeds, sample_idx, temps, top_ps, g_states, g_flags, *tabs):
+                    seeds, sample_idx, temps, top_ps, poison, g_states,
+                    g_flags, *tabs):
             logits, gen = verify_step(
                 config, params, cur[:, None], gen_lens, prompt_lens, gen, prefix
             )
-            logits = _apply_mask(
-                mask_pad(logits[:, 0, :]), g_states, g_flags, tabs
+            # Poison is injected BEFORE the grammar mask: NaNs survive the
+            # mask's allowed positions, so detection sees them either way.
+            logits = jnp.where(
+                poison[:, None], jnp.float32(jnp.nan), logits[:, 0, :]
             )
+            logits = _apply_mask(mask_pad(logits), g_states, g_flags, tabs)
             keys = row_keys(seeds, gen_lens + 1, sample_idx)
-            tok, lp = sample_rows(logits, keys, temps, top_ps)
+            tok, lp, bad = sample_rows(logits, keys, temps, top_ps)
             tok = jnp.where(active, tok, jnp.int32(pad_id))
             lp = jnp.where(active, lp, 0.0)
-            return tok, lp, gen, _advance(tok, g_states, g_flags, tabs)
+            return tok, lp, bad & active, gen, _advance(tok, g_states, g_flags, tabs)
 
         def _step_paged_g(params, pool_k, pool_v, cur, gen_lens, prompt_lens,
                           active, seeds, sample_idx, temps, top_ps, prefix_idx,
-                          gen_idx, write_idx, g_states, g_flags, *tabs):
+                          gen_idx, write_idx, poison, g_states, g_flags, *tabs):
             logits, k_cols, v_cols = paged_verify_step(
                 config, params, cur[:, None], gen_lens, prompt_lens,
                 KVCache(k=pool_k, v=pool_v), prefix_idx, gen_idx,
@@ -573,14 +787,17 @@ class ContinuousDecodeLoop:
             )
             pool_k = pool_k.at[:, write_idx].set(k_cols.astype(pool_k.dtype))
             pool_v = pool_v.at[:, write_idx].set(v_cols.astype(pool_v.dtype))
-            logits = _apply_mask(
-                mask_pad(logits[:, 0, :]), g_states, g_flags, tabs
+            logits = jnp.where(
+                poison[:, None], jnp.float32(jnp.nan), logits[:, 0, :]
             )
+            logits = _apply_mask(mask_pad(logits), g_states, g_flags, tabs)
             keys = row_keys(seeds, gen_lens + 1, sample_idx)
-            tok, lp = sample_rows(logits, keys, temps, top_ps)
+            tok, lp, bad = sample_rows(logits, keys, temps, top_ps)
             tok = jnp.where(active, tok, jnp.int32(pad_id))
             lp = jnp.where(active, lp, 0.0)
-            return tok, lp, pool_k, pool_v, _advance(tok, g_states, g_flags, tabs)
+            return tok, lp, bad & active, pool_k, pool_v, _advance(
+                tok, g_states, g_flags, tabs
+            )
 
         fns = {
             "admit": jax.jit(_admit_g),
@@ -600,38 +817,231 @@ class ContinuousDecodeLoop:
             self._thread.start()
 
     def _worker(self) -> None:
-        try:
-            while True:
-                with self._lock:
-                    if self._stopped:
-                        return
-                    self._admit_locked()
-                    has_work = self._active_mask.any()
-                    if not has_work:
-                        if self._closing and not self._queue:
-                            self._lock.notify_all()
-                            return
-                        # Wake for new arrivals; re-check queued budgets at a
-                        # coarse interval so expired deadlines shed.
-                        self._lock.wait(timeout=0.05)
-                        self._shed_expired_locked()
-                        continue
-                try:
-                    self._step_once()
-                except Exception:
-                    logger.exception("continuous decode step failed")
-                    self._fail_all(BackendUnavailableError(
-                        "continuous decode loop failed; see server logs"
-                    ))
+        """Crash-contained worker: every fault class maps to a recovery
+        domain instead of a silent log line. A hung step (watchdog) or a
+        quarantined page pool tears the engine down and replays the journal;
+        any OTHER exception — the previously-silent worker-death path — fails
+        every queued and in-flight future with a typed error, restarts the
+        loop, and leaves the engine alone. All domains share the
+        ``max_rebuilds`` bound before the loop goes terminal."""
+        while True:
+            try:
+                self._worker_loop()
+                return
+            except _AdoptEngine as swap:
+                if not self._recover("adopt_engine", new_engine=swap.engine):
                     return
-        except Exception:  # pragma: no cover - defensive
-            logger.exception("continuous decode worker crashed")
+            except _StepHung:
+                if not self._recover("hung_step"):
+                    return
+            except (_PoolFault, PageAccountingError):
+                if not self._recover("page_accounting"):
+                    return
+            except Exception:
+                logger.exception("continuous decode worker crashed")
+                RECOVERY_EVENTS.record("continuous.worker_crashes")
+                if not self._recover("worker_crash"):
+                    return
+
+    def _worker_loop(self) -> None:
+        while True:
+            # Crash-injection point for the worker itself: OUTSIDE the
+            # step-level fault domains, so a ``crash`` spec exercises the
+            # top-level containment path (typed flush + bounded restart).
+            _failpoints.fire("continuous.worker")
+            with self._lock:
+                if self._stopped:
+                    return
+                if self._adopted_engine is not None:
+                    eng, self._adopted_engine = self._adopted_engine, None
+                    raise _AdoptEngine(eng)
+                if self._pool_fault is not None:
+                    raise _PoolFault(self._pool_fault)
+                self._admit_locked()
+                has_work = self._active_mask.any()
+                if not has_work:
+                    if self._closing and not self._queue:
+                        self._lock.notify_all()
+                        return
+                    # Wake for new arrivals; re-check queued budgets at a
+                    # coarse interval so expired deadlines shed.
+                    self._lock.wait(timeout=0.05)
+                    self._shed_expired_locked()
+                    continue
+            self._step_once()
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self, reason: str, new_engine: Any = None) -> bool:
+        """Heal the loop after a fault; True when the worker should keep
+        running. Fault domains:
+
+        - ``hung_step`` / ``page_accounting``: journal the in-flight rows,
+          rebuild the engine via ``rebuild_fn`` (fresh KV pool — the old
+          pool's pages die with the torn-down engine, no decref), then
+          re-queue the survivors for byte-identical replay.
+        - ``worker_crash``: the engine is healthy but the host loop is not —
+          fail everything with a typed error (returning every page to the
+          pool on the way) and restart the loop empty.
+        - ``adopt_engine``: an external supervisor already rebuilt the
+          engine; journal + swap + replay without spending a fault credit.
+        """
+        counts = reason != "adopt_engine"
+        with self._lock:
+            self._loop_epoch += 1
+            self._last_recovery_reason = reason
+            self._stats["restarts"] += 1
+            if counts:
+                self._consecutive_faults += 1
+            attempt = self._consecutive_faults
+        RECOVERY_EVENTS.record("continuous.restarts")
+        if counts and attempt > self.max_rebuilds:
+            return self._terminal(EngineHungError(
+                f"continuous decode loop did not recover after "
+                f"{self.max_rebuilds} restart attempt(s); last fault: {reason}"
+            ))
+        if counts and self.on_recovering is not None:
+            self.on_recovering(attempt, f"continuous_{reason}")
+        if reason == "worker_crash":
+            self._fail_all(BackendUnavailableError(
+                "continuous decode worker crashed; in-flight requests were "
+                "failed and the loop restarted"
+            ))
+        else:
+            if new_engine is None and self.rebuild_fn is None:
+                # Unsupervised loop: a wedged device or corrupt pool cannot
+                # heal without a rebuild path — typed terminal, no replay.
+                # (No journal/reset: _terminal's fail-all flushes in-flight
+                # rows, and the quarantine evidence stays visible in stats.)
+                return self._terminal(EngineHungError(
+                    f"continuous decode loop fault '{reason}' is "
+                    "unrecoverable without an engine rebuild path"
+                ))
+            with self._lock:
+                survivors = self._journal_survivors_locked()
+                self._reset_device_state_locked()
+            if new_engine is not None:
+                self.engine = new_engine
+            else:
+                try:
+                    eng = self.rebuild_fn()
+                except BaseException as exc:
+                    RECOVERY_EVENTS.record("supervisor.rebuild_failures")
+                    err = exc if isinstance(exc, CheckpointCorruptError) else (
+                        EngineHungError(
+                            f"continuous loop engine rebuild failed: {exc!r}"
+                        )
+                    )
+                    for req in survivors:
+                        if not req.future.done():
+                            req.future.set_exception(err)
+                    return self._terminal(err)
+                if eng is not None:
+                    self.engine = eng
+            if survivors:
+                with self._lock:
+                    self._queue.extendleft(reversed(survivors))
+                    self._lock.notify_all()
+        if counts and self.on_rebuilt is not None:
+            self.on_rebuilt()
+        return True
+
+    def _terminal(self, err: BaseException) -> bool:
+        """The loop is beyond self-healing: pin the terminal error (submit
+        re-raises it), fail every remaining future, and stop for good."""
+        logger.error("continuous decode loop is terminal: %s", err)
+        with self._lock:
+            self._terminal_error = err
+            self._closing = True
+            self._stopped = True
+        self._fail_all(err)
+        if self.on_rebuild_failed is not None:
+            self.on_rebuild_failed(err)
+        return False
+
+    def _journal_survivors_locked(self) -> List[_SlotRequest]:
+        """Snapshot the in-flight requests for replay (lock held): reset
+        their accumulators and advance the sink watermark so re-admission
+        regenerates from step 0 — self-deterministic row keys make the
+        regenerated stream byte-identical — while already-delivered steps
+        are suppressed, not repeated."""
+        seen: Dict[int, _SlotRequest] = {}
+        for r in self._active:
+            if r is not None and id(r) not in seen and not r.future.done():
+                seen[id(r)] = r
+        survivors = sorted(seen.values(), key=lambda r: r.seq)
+        for req in survivors:
+            req.delivered_watermark = max(
+                req.delivered_watermark, req.steps_delivered
+            )
+            req.steps_delivered = 0
+            req.replays += 1
+            req.slots = []
+            req.tokens = []
+            req.logprobs = []
+            req.done = []
+            req.finish = []
+            req.sample_errors = []
+        return survivors
+
+    def _reset_device_state_locked(self) -> None:
+        """Forget every device handle and slot mirror (lock held). Old pool
+        page references are dropped WITHOUT decref on purpose: the pool dies
+        with the torn-down engine, and decref against a replaced allocator
+        would corrupt the new pool's accounting."""
+        pad = self.engine.config.pad_token_id
+        self._active = [None] * self.width
+        self._free = list(range(self.width))
+        self._active_mask[:] = False
+        self._cur[:] = pad
+        self._gen_lens[:] = 0
+        self._prompt_lens[:] = 1
+        self._seeds[:] = 0
+        self._sample_idx[:] = 0
+        self._temps[:] = 1.0
+        self._top_ps[:] = 1.0
+        self._g_states[:] = 0
+        self._g_flags[:] = False
+        self._grammar = None
+        self._dgrammar = None
+        self._g_programs = None
+        self._sampler_parts = None
+        self._prefix = None
+        self._gen = None
+        self._step_fn = None
+        self._write_prefix_fn = None
+        self._admit_sample_fn = None
+        self._step_paged_fn = None
+        self._pool = None
+        self._tables = [[] for _ in range(self.width)]
+        self._reserved = [[] for _ in range(self.width)]
+        self._prefix_idx[:] = 0
+        self._gen_idx[:] = 0
+        self._pool_fault = None
+        self._built = False
+
+    def adopt_engine(self, new_engine: Any) -> None:
+        """Swap in an externally rebuilt engine (the supervisor's coalesced
+        rebuild path). With work in flight the worker journals, swaps, and
+        replays on its own thread; an idle loop swaps inline."""
+        with self._lock:
+            has_work = bool(self._queue) or any(
+                r is not None for r in self._active
+            )
+            if not has_work:
+                self._loop_epoch += 1
+                self.engine = new_engine
+                self._reset_device_state_locked()
+                return
+            self._adopted_engine = new_engine
+            if not self._stopped:
+                self._ensure_worker()
+            self._lock.notify_all()
 
     def _shed_expired_locked(self) -> None:
         kept: "deque[_SlotRequest]" = deque()
         for req in self._queue:
             if req.budget is not None and req.budget.should_abort():
-                self._pending_prefill.pop(id(req), None)
                 FAILURE_EVENTS.record("scheduler.shed")
                 req.future.set_exception(req.budget.error("continuous queue"))
             else:
@@ -645,9 +1055,6 @@ class ContinuousDecodeLoop:
         admitted request's prefill."""
         while self._queue and len(self._free) >= self._queue[0].n:
             req = self._queue.popleft()
-            ids, prompt_len, seed, temperature, top_p = self._pending_prefill.pop(
-                id(req)
-            )
             if req.budget is not None and req.budget.should_abort():
                 FAILURE_EVENTS.record("scheduler.shed")
                 req.future.set_exception(req.budget.error("continuous queue"))
@@ -658,8 +1065,7 @@ class ContinuousDecodeLoop:
             rows = [self._free.pop(0) for _ in range(req.n)]
             req.slots = rows
             try:
-                self._admit_device(req, rows, ids, prompt_len, seed,
-                                   temperature, top_p)
+                self._admit_device(req, rows)
             except PagePoolExhausted as e:
                 # Pages are a transient resource: in-flight rows free theirs
                 # as they retire, so park the head request and retry after the
@@ -671,9 +1077,6 @@ class ContinuousDecodeLoop:
                     self._free.append(r)
                 req.slots = []
                 if in_flight:
-                    self._pending_prefill[id(req)] = (
-                        ids, prompt_len, seed, temperature, top_p
-                    )
                     self._queue.appendleft(req)
                     break
                 req.future.set_exception(BackendUnavailableError(
@@ -685,14 +1088,21 @@ class ContinuousDecodeLoop:
                     self._free.append(r)
                 req.future.set_exception(e)
                 continue
-            self._stats["admitted"] += 1
-            if in_flight:
-                self._stats["joined_in_flight"] += 1
+            if req.replays:
+                # Journal replay after a rebuild: the rows re-enter the batch
+                # but the request was already counted at first admission.
+                self._stats["replayed_rows"] += req.n
+                RECOVERY_EVENTS.record("continuous.replayed_rows", req.n)
+            else:
+                self._stats["admitted"] += 1
+                if in_flight:
+                    self._stats["joined_in_flight"] += 1
 
-    def _admit_device(self, req, rows, ids, prompt_len, seed, temperature,
-                      top_p) -> None:
+    def _admit_device(self, req, rows) -> None:
         engine = self.engine
-        _ids, _plen, bucket = engine._prep_prompt(ids)
+        prompt_len = req.prompt_len
+        seed, temperature, top_p = req.seed, req.temperature, req.top_p
+        _ids, _plen, bucket = engine._prep_prompt(req.ids)
         n = len(rows)
         if self.paged:
             first_logits = self._admit_paged_kv(req, rows, _ids, _plen, bucket)
@@ -732,23 +1142,27 @@ class ContinuousDecodeLoop:
             g_states = np.full((W,), self._dgrammar.start, np.int32)
             g_flags = np.zeros((W,), bool)
             g_flags[:n] = True
-            tok0, lp0, st0 = fns["admit"](
+            tok0, lp0, bad0, st0 = fns["admit"](
                 fl, jnp.asarray(seeds), jnp.asarray(sidx), jnp.asarray(temps),
                 jnp.asarray(tps), jnp.asarray(g_states), jnp.asarray(g_flags),
                 *self._g_tabs(),
             )
-            tok0, lp0, st0 = map(np.asarray, jax.device_get((tok0, lp0, st0)))
-            tok0, lp0, st0 = tok0[:n], lp0[:n], st0[:n]
+            tok0, lp0, bad0, st0 = map(
+                np.asarray, jax.device_get((tok0, lp0, bad0, st0))
+            )
+            tok0, lp0, bad0, st0 = tok0[:n], lp0[:n], bad0[:n], st0[:n]
             GRAMMAR_EVENTS.record("grammar.masked_steps", n)
         else:
-            tok0, lp0 = self._admit_sample_fn(
+            tok0, lp0, bad0 = self._admit_sample_fn(
                 fl, jnp.asarray(seeds), jnp.asarray(sidx), jnp.asarray(temps),
                 jnp.asarray(tps),
             )
             tok0 = np.asarray(jax.device_get(tok0))[:n]
             lp0 = np.asarray(jax.device_get(lp0))[:n]
+            bad0 = np.asarray(jax.device_get(bad0))[:n]
             st0 = np.zeros((n,), np.int32)
 
+        quarantined = 0
         for j, slot in enumerate(rows):
             self._active[slot] = req
             self._active_mask[slot] = True
@@ -763,12 +1177,38 @@ class ContinuousDecodeLoop:
             self._g_states[slot] = st0[j]
             req.tokens.append([int(tok0[j])])
             req.logprobs.append([float(lp0[j])])
+            req.sample_errors.append(None)
+            if bad0[j]:
+                # Poisoned prefill logits: freeze the row before it ever
+                # decodes; siblings proceed and consensus drops this member.
+                self._quarantine_row(req, j)
+                quarantined += 1
+                continue
             done0 = int(tok0[j]) in self.eos_ids
             req.done.append(done0 or req.max_new <= 1)
             req.finish.append("stop" if done0 else "length")
+        if quarantined:
+            note = getattr(self.engine, "_note_quarantine", None)
+            if note is not None:
+                note(quarantined, n)
         self._deliver_sink(req)
         self._retire_finished_rows(req)
         self._resolve_if_done(req)
+
+    def _quarantine_row(self, req: _SlotRequest, j: int) -> None:
+        """Freeze sample ``j``: typed ``numeric_poison`` member error, row
+        done (the caller retires it and frees the slot). The request's other
+        samples keep decoding — per-ROW fault domain, not per-request."""
+        if len(req.done) <= j:
+            req.done.append(True)
+        else:
+            req.done[j] = True
+        if len(req.finish) <= j:
+            req.finish.append("stop")
+        else:
+            req.finish[j] = "stop"
+        req.sample_errors[j] = _quarantine_error()
+        self._stats["quarantined_rows"] += 1
 
     # -- paged slot management --------------------------------------------
 
@@ -904,9 +1344,7 @@ class ContinuousDecodeLoop:
 
     def _step_once(self) -> None:
         with self._lock:
-            active_reqs = {
-                id(r): r for r in self._active if r is not None
-            }
+            epoch = self._loop_epoch
             cur = jnp.asarray(self._cur)
             gen_lens = jnp.asarray(self._gen_lens)
             prompt_lens = jnp.asarray(self._prompt_lens)
@@ -915,10 +1353,12 @@ class ContinuousDecodeLoop:
             sidx = jnp.asarray(self._sample_idx)
             temps = jnp.asarray(self._temps)
             tps = jnp.asarray(self._top_ps)
+            live_rows = np.flatnonzero(self._active_mask)
             # Grammar twins run only when a constrained row is live: steps
             # with no grammar work dispatch the ORIGINAL programs, so the
             # unconstrained loop stays byte-identical (and program-identical).
             n_masked = int((self._g_flags & self._active_mask).sum())
+            g_states = g_flags = g_fns = g_tabs = None
             if n_masked:
                 g_states = jnp.asarray(self._g_states)
                 g_flags = jnp.asarray(self._g_flags)
@@ -928,56 +1368,104 @@ class ContinuousDecodeLoop:
                 write_idx = jnp.asarray(self._prepare_step_pages())
                 pidx = jnp.asarray(self._prefix_idx)
                 gidx = jnp.asarray(self._gen_idx)
-        new_g = None
-        if self.paged:
-            pool = self._pool
-            note_paged_attn_dispatch(self._paged_attn_impl)
-            with pool.lock:
-                note_device_dispatch("continuous paged step")
+        # All-False in production; with an active ``engine.logits`` nan
+        # failpoint, a seeded subset of the LIVE rows is poisoned — the
+        # loop-scoped twin of the batch path's first-step injection.
+        poison = self.engine._poison0_array(
+            # kllms: ignore[host-sync-hot-path] — live_rows is np.flatnonzero output (already host memory); this tolist is pure host bookkeeping, not a device readback
+            self.width, live_rows=live_rows.tolist()
+        )
+
+        def _dispatch():
+            # Hang-injection point for the step itself (``continuous.step``):
+            # fire() sleeps inline, so a ``hang`` spec wedges THIS disposable
+            # thread under the watchdog budget, exactly like a stuck device.
+            _failpoints.fire("continuous.step")
+            if self._loop_epoch != epoch:
+                raise _StaleStep("continuous step fenced before dispatch")
+            if self.paged:
+                pool = self._pool
+                note_paged_attn_dispatch(self._paged_attn_impl)
+                with pool.lock:
+                    note_device_dispatch("continuous paged step")
+                    if n_masked:
+                        tok, lp, bad, new_k, new_v, new_g = g_fns["step_paged"](
+                            self.engine.params, pool.kv.k, pool.kv.v, cur,
+                            gen_lens, prompt_lens, active, seeds, sidx, temps,
+                            tps, pidx, gidx, write_idx, poison, g_states,
+                            g_flags, *g_tabs,
+                        )
+                    else:
+                        tok, lp, bad, new_k, new_v = self._step_paged_fn(
+                            self.engine.params, pool.kv.k, pool.kv.v, cur,
+                            gen_lens, prompt_lens, active, seeds, sidx, temps,
+                            tps, pidx, gidx, write_idx, poison,
+                        )
+                        new_g = None
+                    if self._loop_epoch != epoch:
+                        raise _StaleStep("continuous step fenced post-dispatch")
+                    pool.kv = KVCache(k=new_k, v=new_v)
+            else:
+                note_device_dispatch("continuous dense step")
                 if n_masked:
-                    tok, lp, new_k, new_v, new_g = g_fns["step_paged"](
-                        self.engine.params, pool.kv.k, pool.kv.v, cur,
+                    tok, lp, bad, gen, new_g = g_fns["step"](
+                        self.engine.params, self._prefix, self._gen, cur,
                         gen_lens, prompt_lens, active, seeds, sidx, temps,
-                        tps, pidx, gidx, write_idx, g_states, g_flags,
-                        *g_tabs,
+                        tps, poison, g_states, g_flags, *g_tabs,
                     )
                 else:
-                    tok, lp, new_k, new_v = self._step_paged_fn(
-                        self.engine.params, pool.kv.k, pool.kv.v, cur,
+                    tok, lp, bad, gen = self._step_fn(
+                        self.engine.params, self._prefix, self._gen, cur,
                         gen_lens, prompt_lens, active, seeds, sidx, temps,
-                        tps, pidx, gidx, write_idx,
+                        tps, poison,
                     )
-                pool.kv = KVCache(k=new_k, v=new_v)
+                    new_g = None
+                # An abandoned thread waking into a rebuilt loop must not
+                # clobber the new generation cache with the old epoch's.
+                if self._loop_epoch != epoch:
+                    raise _StaleStep("continuous step fenced post-dispatch")
+                self._gen = gen
+            # The one by-design sync per step: slot bookkeeping below needs
+            # the sampled token ids on the host, and it runs outside both
+            # locks (advanced grammar states ride the same fetch).
+            # kllms: ignore[host-sync-hot-path] — the per-step result readback; everything after it is host-side bookkeeping
+            outs = (tok, lp, bad) if new_g is None else (tok, lp, bad, new_g)
+            return list(map(np.asarray, jax.device_get(outs)))
+
+        if self.budget_model is not None:
+            t0 = time.monotonic()
+            try:
+                fetched = self._dispatcher.run(
+                    _dispatch, self.budget_model.step_budget()
+                )
+            except _StepHung:
+                with self._lock:
+                    self._loop_epoch += 1
+                RECOVERY_EVENTS.record("continuous.step_hangs")
+                logger.error(
+                    "continuous step overran its watchdog budget; abandoning "
+                    "the dispatch thread and rebuilding"
+                )
+                raise
+            self.budget_model.observe_step(time.monotonic() - t0)
         else:
-            note_device_dispatch("continuous dense step")
-            if n_masked:
-                tok, lp, self._gen, new_g = g_fns["step"](
-                    self.engine.params, self._prefix, self._gen, cur,
-                    gen_lens, prompt_lens, active, seeds, sidx, temps, tps,
-                    g_states, g_flags, *g_tabs,
-                )
-            else:
-                tok, lp, self._gen = self._step_fn(
-                    self.engine.params, self._prefix, self._gen, cur,
-                    gen_lens, prompt_lens, active, seeds, sidx, temps, tps,
-                )
-        # The one by-design sync per step: slot bookkeeping below needs the
-        # sampled token ids on the host, and it runs outside both locks
-        # (advanced grammar states ride the same fetch — no extra sync).
-        # kllms: ignore[host-sync-hot-path] — the per-step result readback; everything after it is host-side bookkeeping
-        fetched = list(map(np.asarray, jax.device_get((tok, lp) if new_g is None else (tok, lp, new_g))))
-        tok_np, lp_np = fetched[0], fetched[1]
+            fetched = _dispatch()
+        tok_np, lp_np, bad_np = fetched[0], fetched[1], fetched[2]
+        quarantined = 0
         with self._lock:
-            if new_g is not None:
+            if n_masked:
                 # .copy(): device_get may hand back a read-only view, and the
                 # mirror is written per-slot at admission/retirement.
-                self._g_states = fetched[2].copy()
+                self._g_states = fetched[3].copy()
                 GRAMMAR_EVENTS.record("grammar.masked_steps", n_masked)
             self._stats["steps"] += 1
             self._stats["row_steps"] += int(self._active_mask.sum())
             self._stats["max_active_rows"] = max(
                 self._stats["max_active_rows"], int(self._active_mask.sum())
             )
+            # A completed step is proof of life: recovery credits refill so
+            # intermittent faults don't accumulate toward terminal.
+            self._consecutive_faults = 0
             touched = set()
             for slot in range(self.width):
                 req = self._active[slot]
@@ -987,6 +1475,13 @@ class ContinuousDecodeLoop:
                 if req.done[j]:
                     continue
                 self._gen_lens[slot] += 1  # cur's KV is now written
+                if bad_np[slot]:
+                    # Numeric poison: freeze + retire this row only; its
+                    # garbage token never reaches the accumulators or sinks.
+                    self._quarantine_row(req, j)
+                    quarantined += 1
+                    touched.add(id(req))
+                    continue
                 t = int(tok_np[slot])
                 self._cur[slot] = t
                 req.tokens[j].append(t)
@@ -1009,6 +1504,12 @@ class ContinuousDecodeLoop:
                 self._retire_finished_rows(req)
                 self._resolve_if_done(req)
             self._lock.notify_all()
+        # Quarantine accounting + supervisor hook OUTSIDE the loop lock (it
+        # fans out to scheduler/supervisor locks); clean steps report 0 so
+        # the escalation window decays, same contract as the batch path.
+        note = getattr(self.engine, "_note_quarantine", None)
+        if note is not None:
+            note(quarantined, int(live_rows.size))
 
     # -- retirement --------------------------------------------------------
 
@@ -1016,6 +1517,13 @@ class ContinuousDecodeLoop:
         if req.token_sink is None:
             return
         step = req.steps_delivered
+        req.steps_delivered += 1
+        # Replay de-duplication: steps below the watermark were already
+        # delivered before the fault; the rebuilt loop regenerates them
+        # byte-identically (self-deterministic keys) but must not re-send
+        # them — the SSE consumer sees one contiguous stream.
+        if step < req.delivered_watermark:
+            return
         # Every live sample has produced its step-th token by construction
         # (rows of one request march in lockstep until they finish; finished
         # rows report pad thereafter, which the sink's detokenizer skips).
@@ -1032,7 +1540,6 @@ class ContinuousDecodeLoop:
         except Exception:
             logger.exception("continuous token sink failed; dropping tap")
             req.token_sink = None
-        req.steps_delivered += 1
 
     def _retire_finished_rows(self, req: _SlotRequest) -> None:
         for j, slot in enumerate(list(req.slots)):
@@ -1058,7 +1565,15 @@ class ContinuousDecodeLoop:
         toks = np.full((req.n, req.max_new), pad, np.int32)
         lps = np.zeros((req.n, req.max_new), np.float32)
         lengths = np.zeros((req.n,), np.int32)
+        errs = list(req.sample_errors)
+        while len(errs) < req.n:
+            errs.append(None)
         for j in range(req.n):
+            if errs[j] is not None:
+                # Quarantined member: wiped like the batch path's
+                # _quarantine_result (tokens→pad, logprobs→0, length→0) so
+                # survivor consensus drops it from the vote.
+                continue
             L = len(req.tokens[j])
             # eos is recorded in the buffer like the batch loop (lengths count
             # non-pad tokens; the backend strips stop ids from the text).
@@ -1072,6 +1587,7 @@ class ContinuousDecodeLoop:
             finish_reasons=list(req.finish),
             prompt_len=req.prompt_len,
             spec_stats={},
+            sample_errors=errs if any(e is not None for e in errs) else None,
         )
         self._stats["completed"] += 1
         if not req.future.done():
@@ -1090,9 +1606,24 @@ class ContinuousDecodeLoop:
         with self._lock:
             reqs = {id(r): r for r in self._active if r is not None}
             for req in reqs.values():
-                for j in range(req.n):
+                for j in range(len(req.done)):
                     req.done[j] = True
-                self._retire_finished_rows(req)
+                try:
+                    self._retire_finished_rows(req)
+                except PageAccountingError:
+                    # Containment must complete even over a corrupt
+                    # allocator: drop the slots without decref (the pool is
+                    # already quarantined) so every future still resolves.
+                    logger.exception(
+                        "page release failed during fail-all; dropping slots"
+                    )
+                    for slot in list(req.slots):
+                        if self._active[slot] is req:
+                            self._active[slot] = None
+                            self._active_mask[slot] = False
+                            self._tables[slot] = []
+                            self._reserved[slot] = []
+                            self._free.append(slot)
                 if not req.future.done():
                     req.future.set_exception(exc)
             for req in self._queue:

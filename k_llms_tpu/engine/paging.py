@@ -224,6 +224,18 @@ class PageAllocator:
                         f"page {p} is free but has refcount {int(self._ref[p])}"
                     )
 
+    def check(self) -> Optional[str]:
+        """Non-raising :meth:`verify`: the violation message, or None when
+        the conservation laws hold. For callers that treat a corrupt pool as
+        DATA — the continuous loop's stats quarantine reports the fault and
+        flags the worker for rebuild instead of letting an accounting raise
+        poison every subsequent health poll."""
+        try:
+            self.verify()
+        except PageAccountingError as e:
+            return str(e)
+        return None
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {

@@ -68,6 +68,18 @@ Injection sites wired in this package:
                            ``grammar.fallback_error``) — the contract under
                            drill is that constrained decoding never errors a
                            request
+- ``continuous.step``    — evaluated inside the continuous decode loop's
+                           per-step device dispatch (``engine/continuous.py``),
+                           i.e. under the loop watchdog's step budget; a
+                           ``hang`` spec wedges the dispatch so the watchdog
+                           must epoch-fence the abandoned thread, rebuild the
+                           engine, and replay the journaled in-flight rows
+- ``continuous.worker``  — evaluated at the top of every continuous-loop
+                           worker iteration, OUTSIDE the step-level error
+                           guard; the ``crash`` action kills the worker thread
+                           itself so crash containment must flush every queued
+                           and in-flight future with a typed error and restart
+                           the loop (bounded by ``max_rebuilds``)
 
 Actions (``FailSpec.action``):
 
@@ -103,6 +115,11 @@ Actions (``FailSpec.action``):
                        recording the fallback counters (device consensus ->
                        host scorer; paged attention -> XLA reference;
                        grammar mask -> unconstrained + post-hoc validation)
+- ``"crash"``        — raise a RuntimeError shaped like an unexpected worker
+                       death; distinct from ``raise`` so a crash-containment
+                       spec reads as what it simulates and so the env syntax
+                       defaults to firing once (a crash on *every* iteration
+                       is a rebuild storm, not a drill)
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -120,10 +137,13 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="ops.paged_attn=fallback:2"
     KLLMS_FAILPOINTS="engine.grammar=fallback:1"
     KLLMS_FAILPOINTS="engine.grammar=raise:1"
+    KLLMS_FAILPOINTS="continuous.step=hang:1:3"
+    KLLMS_FAILPOINTS="continuous.worker=crash:1"
 where the first numeric arg is ``times`` for
-raise/sleep/oom/corrupt/disconnect/fallback specs, ``times[:delay]`` for hang,
-``kill[:seed]`` for kill_samples/nan, ``kill`` (pages to drop) for leak, and
-``member[:times]`` for down/fail (replica sites are keyed by replica id).
+raise/sleep/oom/corrupt/disconnect/fallback/crash specs (crash defaults to
+firing once), ``times[:delay]`` for hang, ``kill[:seed]`` for
+kill_samples/nan, ``kill`` (pages to drop) for leak, and ``member[:times]``
+for down/fail (replica sites are keyed by replica id).
 """
 
 from __future__ import annotations
@@ -154,6 +174,8 @@ SITES = (
     "consensus.device",
     "ops.paged_attn",
     "engine.grammar",
+    "continuous.step",
+    "continuous.worker",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the
@@ -175,7 +197,7 @@ def _injected_oom() -> BaseException:
 @dataclass
 class FailSpec:
     # "raise" | "oom" | "sleep" | "hang" | "kill_samples" | "nan" | "corrupt"
-    # | "down" | "fail" | "disconnect" | "leak" | "fallback"
+    # | "down" | "fail" | "disconnect" | "leak" | "fallback" | "crash"
     action: str = "raise"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
@@ -201,6 +223,7 @@ class FailSpec:
             "disconnect",
             "leak",
             "fallback",
+            "crash",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -236,6 +259,10 @@ def fire(site: str) -> Optional[FailSpec]:
     logger.debug("failpoint %s fired (%s)", site, spec.action)
     if spec.action == "raise":
         raise spec.error_factory()
+    if spec.action == "crash":
+        raise RuntimeError(
+            f"injected worker crash (failpoint): site {site} killed its thread"
+        )
     if spec.action == "oom":
         raise _injected_oom()
     if spec.action in ("sleep", "hang"):
@@ -337,6 +364,10 @@ def configure_from_env(env: Optional[str] = None) -> None:
         elif action in ("oom", "corrupt", "disconnect", "fallback"):
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action=action, times=times)
+        elif action == "crash":
+            # Unbounded crash specs are rebuild storms, not drills: default 1.
+            times = int(args[0]) if args else 1
+            specs[site] = FailSpec(action="crash", times=times)
         elif action in ("down", "fail"):
             member = args[0] if args and args[0] else None
             times = int(args[1]) if len(args) > 1 else None

@@ -92,6 +92,20 @@ class LaunchBudgetModel:
                 self._per_token_s = a * sample + (1.0 - a) * self._per_token_s
             self._observed += 1
 
+    # -- per-step budget (continuous decode loop) --------------------------
+    #
+    # The continuous loop's unit of dispatch is one STEP — a single token
+    # across every active slot row — so its watchdog budget is the
+    # max_new_tokens=1 specialization of the launch budget: the same EWMA,
+    # the same clamp, learned one step at a time. The floor still absorbs
+    # first-step compile (a new batch shape recompiles mid-loop).
+
+    def step_budget(self) -> float:
+        return self.budget(1, 1)
+
+    def observe_step(self, elapsed_s: float) -> None:
+        self.observe(1, 1, elapsed_s)
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {

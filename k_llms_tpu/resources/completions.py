@@ -269,9 +269,20 @@ class ChatCompletionStream:
                 (completion,) = payload
                 self._completion = completion
                 for i, choice in enumerate(completion.choices):
-                    self._pending.append(
-                        self._chunk(i + 1, {}, finish_reason=choice.finish_reason)
+                    chunk = self._chunk(
+                        i + 1, {}, finish_reason=choice.finish_reason
                     )
+                    err = getattr(choice, "sample_error", None)
+                    if err is not None:
+                        # Terminal typed per-sample error: this row was lost
+                        # mid-decode (numeric quarantine, injected kill) and
+                        # produced no further deltas — the finish chunk
+                        # carries the same ``sample_error`` payload the
+                        # non-streaming response attaches, so streaming
+                        # clients learn WHY the sample went silent instead
+                        # of seeing a bare early "stop".
+                        chunk["choices"][0]["sample_error"] = dict(err)
+                    self._pending.append(chunk)
                 continue
             if kind == "final":
                 (result,) = payload

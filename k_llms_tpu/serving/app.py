@@ -154,9 +154,14 @@ class ServingApp:
         cont = getattr(backend, "_continuous", None)
         if cont is not None:
             for key, val in sorted(cont.stats.items()):
-                if isinstance(val, dict):
-                    continue  # nested sections (page pool) export below
-                lines.append(f"kllms_continuous_{key} {val}")
+                # Numeric gauges only: the stats snapshot also carries nested
+                # sections (page pool — exported below via health), strings
+                # (last_recovery_reason), and Nones, none of which are
+                # Prometheus sample values.
+                if isinstance(val, bool):
+                    lines.append(f"kllms_continuous_{key} {int(val)}")
+                elif isinstance(val, (int, float)):
+                    lines.append(f"kllms_continuous_{key} {val}")
         # HBM + paged-KV pool gauges from the backend's health snapshot (the
         # read doubles as a page-accounting invariant check).
         if backend is not None and hasattr(backend, "health"):
@@ -259,6 +264,19 @@ class ServingApp:
             return
         _obs.STREAM_EVENTS.record("streams.opened")
 
+        # SSE keep-alive: while the decode sits in the admission queue (or a
+        # recovery replay re-prefills), no data events flow — emit ``: ping``
+        # comment frames at the configured cadence so idle-timeout proxies
+        # keep the connection open. 0 disables.
+        backend = getattr(self.client, "backend", None)
+        ping_interval = float(
+            getattr(
+                getattr(backend, "backend_config", None),
+                "sse_ping_interval_s", 0.0,
+            )
+            or 0.0
+        )
+
         loop = asyncio.get_running_loop()
         queue: "asyncio.Queue[Tuple[str, Any]]" = asyncio.Queue()
 
@@ -280,10 +298,32 @@ class ServingApp:
         try:
             while True:
                 get_task = asyncio.ensure_future(queue.get())
-                done, _ = await asyncio.wait(
-                    {get_task, disconnect_task},
-                    return_when=asyncio.FIRST_COMPLETED,
-                )
+                while True:
+                    done, _ = await asyncio.wait(
+                        {get_task, disconnect_task},
+                        timeout=ping_interval if ping_interval > 0 else None,
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if done:
+                        break
+                    # Idle gap: heartbeat. The first ping may have to open the
+                    # response itself (a queued request has produced nothing
+                    # yet); an error surfacing after that rides the stream as
+                    # an SSE error event, exactly like any post-first-delta
+                    # failure.
+                    if not started:
+                        await send({
+                            "type": "http.response.start",
+                            "status": 200,
+                            "headers": list(sse.HEADERS),
+                        })
+                        started = True
+                    await send({
+                        "type": "http.response.body",
+                        "body": sse.PING,
+                        "more_body": True,
+                    })
+                    _obs.STREAM_EVENTS.record("streams.pings")
                 if disconnect_task in done:
                     get_task.cancel()
                     await self._abort_stream(stream_obj, "client disconnected")

@@ -12,6 +12,13 @@ from typing import Any, Dict, Iterator, Tuple
 
 DONE = b"data: [DONE]\n\n"
 
+# Keep-alive comment frame: SSE spec section 7 — lines starting with ``:``
+# are ignored by conforming clients (openai libraries included), so this
+# heartbeat keeps idle-timeout proxies from severing a stream that is
+# waiting in the admission queue or mid-prefill without polluting the
+# event sequence.
+PING = b": ping\n\n"
+
 HEADERS = [
     (b"content-type", b"text/event-stream; charset=utf-8"),
     (b"cache-control", b"no-cache"),

@@ -144,14 +144,30 @@ SPEC_EVENTS = EventCounters(declared=(
 
 #: Process-wide self-healing counters (supervisor.hung_launches,
 #: supervisor.rebuilds, supervisor.rebuild_failures, supervisor.replayed,
-#: supervisor.stale_results_discarded), fed by the EngineSupervisor. A nonzero
-#: rebuild count on a healthy fleet is the "devices are flaking" alarm.
+#: supervisor.stale_results_discarded), fed by the EngineSupervisor, plus the
+#: continuous decode loop's fault-domain counters (continuous.step_hangs —
+#: per-step dispatches the loop watchdog abandoned; continuous.worker_crashes
+#: — worker threads killed by an unexpected host exception;
+#: continuous.restarts — loop recoveries of either kind that rebuilt/restarted
+#: the decode loop; continuous.replayed_rows — journaled in-flight rows
+#: re-admitted and replayed after a rebuild; continuous.stale_steps_discarded
+#: — epoch-fenced results from abandoned step threads that landed late and
+#: were dropped; continuous.pool_quarantined — page-accounting faults that
+#: quarantined the pool for rebuild instead of poisoning health polls), fed by
+#: ContinuousDecodeLoop. A nonzero rebuild count on a healthy fleet is the
+#: "devices are flaking" alarm.
 RECOVERY_EVENTS = EventCounters(declared=(
     "supervisor.hung_launches",
     "supervisor.rebuilds",
     "supervisor.rebuild_failures",
     "supervisor.replayed",
     "supervisor.stale_results_discarded",
+    "continuous.step_hangs",
+    "continuous.worker_crashes",
+    "continuous.restarts",
+    "continuous.replayed_rows",
+    "continuous.stale_steps_discarded",
+    "continuous.pool_quarantined",
 ))
 
 #: Process-wide replica-routing counters (route.dispatched, route.pulled —
@@ -278,6 +294,7 @@ STREAM_EVENTS = EventCounters(declared=(
     "streams.completed",
     "streams.aborted",
     "tokens.streamed",
+    "streams.pings",  # SSE keep-alive comment frames (idle-gap heartbeats)
 ))
 
 

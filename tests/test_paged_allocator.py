@@ -13,6 +13,7 @@ leak detector. CPU-only, no device work except the tiny leak-loop test.
 """
 
 import random
+import time
 
 import numpy as np
 import pytest
@@ -26,6 +27,7 @@ from k_llms_tpu.engine.paging import (
     pages_for,
 )
 from k_llms_tpu.reliability.failpoints import FailSpec, failpoints
+from k_llms_tpu.types.wire import EngineHungError
 
 
 class _RefAllocator:
@@ -155,9 +157,10 @@ def test_leak_detection_via_verify():
 def test_leak_failpoint_trips_loop_stats():
     """``engine.pages=leak:N`` fires on slot retirement in the continuous
     loop; the next ``stats`` read (what backend ``health()`` polls) must
-    raise PageAccountingError rather than keep serving from a corrupt pool.
-    Uses a private engine: the poisoned pool must not leak into the shared
-    fixtures."""
+    QUARANTINE the pool — report the accounting fault as data and flag the
+    worker for rebuild — rather than raise into (and keep poisoning) every
+    subsequent health poll. Uses a private engine: the poisoned pool must
+    not leak into the shared fixtures."""
     from k_llms_tpu.engine.continuous import ContinuousDecodeLoop
     from k_llms_tpu.engine.engine import LocalEngine
     from k_llms_tpu.models import get_config
@@ -176,8 +179,22 @@ def test_leak_failpoint_trips_loop_stats():
                 [3, 1, 4, 1, 5], n=1, max_new=4, temperature=0.0, top_p=None,
                 seed=2,
             ).result(timeout=120)
-        with pytest.raises(PageAccountingError, match="leak"):
-            loop.stats
+        pages = loop.stats["pages"]
+        assert pages["quarantined"] is True
+        assert "leak" in pages["error"]
+        # Polling stays safe (no raise), and — with no rebuild path on this
+        # bare loop — the worker drives the fault to a typed terminal state
+        # instead of serving from the corrupt pool.
+        deadline = time.monotonic() + 10.0
+        while loop._terminal_error is None and time.monotonic() < deadline:
+            _ = loop.stats
+            time.sleep(0.01)
+        assert isinstance(loop._terminal_error, EngineHungError)
+        assert loop.stats["pages"]["quarantined"] is True
+        with pytest.raises(EngineHungError):
+            loop.submit(
+                [3, 1, 4], n=1, max_new=2, temperature=0.0, top_p=None, seed=2
+            )
     finally:
         loop.stop()
 

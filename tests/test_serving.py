@@ -430,6 +430,45 @@ def test_real_socket_smoke():
         )
 
 
+def test_real_socket_sse_keepalive_pings_during_idle_gap():
+    """Keep-alive contract (PR 13): when the backend goes quiet longer than
+    ``sse_ping_interval_s``, the stream emits ``: ping`` comment frames so
+    idle-timeout proxies don't sever a healthy long decode — and the SSE
+    parser treats them as invisible (comment lines, not events)."""
+    from types import SimpleNamespace
+
+    client = _fake_client()
+    backend = client.backend
+    backend.backend_config = SimpleNamespace(sse_ping_interval_s=0.15)
+    orig = backend.chat_completion_stream
+
+    def slow_stream(request, emit):
+        time.sleep(0.7)  # idle gap before the first delta: ~4 ping windows
+        return orig(request, emit)
+
+    backend.chat_completion_stream = slow_stream
+    pings_before = STREAM_EVENTS.snapshot().get("streams.pings", 0)
+    with ServerThread(ServingApp(client)) as srv:
+        with httpx.stream(
+            "POST",
+            srv.base_url + "/v1/chat/completions",
+            json={**BODY, "stream": True},
+            timeout=30,
+        ) as resp:
+            assert resp.status_code == 200
+            raw = b"".join(resp.iter_raw())
+    assert raw.count(b": ping\n\n") >= 2
+    assert STREAM_EVENTS.snapshot()["streams.pings"] >= pings_before + 2
+    # Comment frames are transparent to consumers: the event stream parses
+    # exactly as if they were never sent.
+    events = list(parse_stream(raw))
+    assert events[-1] == ("done", None)
+    assert any(
+        d["object"] == "chat.completion" for kind, d in events if kind == "data"
+    )
+    client.close()
+
+
 @pytest.mark.slow
 def test_real_socket_tpu_stream_and_disconnect_soak():
     """Acceptance soak: a real-socket client that drops the TCP connection
