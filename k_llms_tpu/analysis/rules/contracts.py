@@ -11,7 +11,10 @@ any single file:
 - **counter-hygiene** — every ``*_EVENTS.record(...)`` literal (or f-string
   shape) is covered by its group's ``declared=`` patterns; every declared
   non-wildcard counter is actually recorded somewhere; every group is
-  surfaced by the ``/metrics`` endpoint.
+  surfaced by the ``/metrics`` endpoint. The same contract covers
+  ``LatencyHistograms``: every ``observe(...)`` against a declared histogram
+  group uses a declared family, every declared family is observed somewhere,
+  and the group is surfaced on ``/metrics``.
 - **wire-error-contract** — every direct ``KLLMsError`` subclass pins
   ``type`` and ``status_code`` in its class body, and every ``as_wire``
   override builds on ``super().as_wire()`` so the base error envelope
@@ -209,9 +212,13 @@ class CounterHygieneRule(Rule):
     invariant = (
         "each *_EVENTS.record(name) literal (or f-string shape) matches a "
         "pattern in that group's declared= tuple; each declared non-wildcard "
-        "counter is recorded somewhere; each group is surfaced on /metrics"
+        "counter is recorded somewhere; each group is surfaced on /metrics; "
+        "the same holds for LatencyHistograms families via observe()"
     )
-    subsystem = "utils/observability.py + all record() call sites + serving/app.py"
+    subsystem = (
+        "utils/observability.py + observability/ + all record()/observe() "
+        "call sites + serving/app.py"
+    )
 
     def _declared_groups(
         self, pf: ProjectFile
@@ -331,6 +338,120 @@ class CounterHygieneRule(Rule):
                         obs.rel,
                         lineno,
                         f"counter group {name} is not surfaced by "
+                        f"{metrics.rel} — /metrics must export every group",
+                    )
+
+        yield from self._check_histograms(project, metrics)
+
+    def _check_histograms(
+        self, project: Project, metrics: Optional[ProjectFile]
+    ) -> Iterable[Finding]:
+        """Mirror the counter contract for ``LatencyHistograms`` families.
+
+        Histogram groups are module-level ``NAME = LatencyHistograms(...)``
+        assignments anywhere in the package (the canonical ``LATENCY`` lives
+        in ``observability/histograms.py``; ``utils/observability.py`` only
+        re-exports it, which is an ImportFrom, not an Assign). ``observe()``
+        receivers are matched by the group's name normalised for private
+        aliases (``self._latency.observe`` attributes to ``LATENCY``)."""
+        hist_groups: Dict[str, Tuple[List[str], int, ProjectFile]] = {}
+        for pf in project.files:
+            if pf.tree is None:
+                continue
+            for name, call, lineno in _module_assign_calls(
+                pf, "LatencyHistograms"
+            ):
+                declared: Optional[List[str]] = None
+                for kw in call.keywords:
+                    if kw.arg == "declared" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)
+                    ):
+                        declared = [
+                            s for s in (str_const(e) for e in kw.value.elts) if s
+                        ]
+                hist_groups[name] = (
+                    declared if declared is not None else [],
+                    lineno,
+                    pf,
+                )
+
+        for name, (declared, lineno, pf) in hist_groups.items():
+            if not declared:
+                yield Finding(
+                    self.id,
+                    pf.rel,
+                    lineno,
+                    f"histogram group {name} is constructed without declared= "
+                    "— undeclared groups accept typo'd family names silently",
+                )
+
+        norm_groups = {g.lstrip("_").upper(): g for g in hist_groups}
+        observed_literals: Set[str] = set()
+        observed_globs: Set[str] = set()
+        for pf in project.files:
+            if pf.tree is None:
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                parts = d.split(".")
+                if parts[-1] != "observe" or len(parts) < 2:
+                    continue
+                group = norm_groups.get(parts[-2].lstrip("_").upper())
+                if group is None:
+                    continue
+                declared, _, _ = hist_groups[group]
+                if not declared:
+                    continue  # already flagged at the declaration
+                if not node.args:
+                    continue
+                shape = self._record_shape(node.args[0])
+                if shape is None:
+                    continue  # dynamic family name; statically unresolvable
+                text, is_glob = shape
+                if is_glob:
+                    observed_globs.add(text)
+                    example = text.replace("*", "x")
+                else:
+                    observed_literals.add(text)
+                    example = text
+                if not any(fnmatch.fnmatch(example, pat) for pat in declared):
+                    yield Finding(
+                        self.id,
+                        pf.rel,
+                        node.lineno,
+                        f"histogram family {text!r} observed on {group} is "
+                        f"not covered by its declared= patterns {declared}",
+                    )
+
+        for name, (declared, lineno, pf) in hist_groups.items():
+            for pat in declared:
+                if "*" in pat or "?" in pat:
+                    continue
+                if pat in observed_literals:
+                    continue
+                if any(fnmatch.fnmatch(pat, g) for g in observed_globs):
+                    continue
+                yield Finding(
+                    self.id,
+                    pf.rel,
+                    lineno,
+                    f"declared histogram family {pat!r} in group {name} is "
+                    "never observed anywhere — stale name or dead "
+                    "instrumentation",
+                )
+
+        if metrics is not None:
+            for name, (_, lineno, pf) in hist_groups.items():
+                if name not in metrics.text:
+                    yield Finding(
+                        self.id,
+                        pf.rel,
+                        lineno,
+                        f"histogram group {name} is not surfaced by "
                         f"{metrics.rel} — /metrics must export every group",
                     )
 

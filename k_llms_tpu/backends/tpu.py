@@ -24,6 +24,7 @@ from ..engine.engine import LocalEngine
 from ..engine.tokenizer import get_tokenizer
 from ..models.config import get_config
 from ..types import ChatCompletion
+from ..utils.observability import LATENCY, current_trace
 from .base import Backend, ChatRequest
 
 # Embedding inputs crop at the same token cap as the reference (`client.py:12`).
@@ -137,6 +138,11 @@ class BackendConfig(BaseModel):
     # event (admission queue wait, long prefill), so idle-timeout proxies
     # don't sever the connection before the first token. 0 disables.
     sse_ping_interval_s: float = 15.0
+    # Debug surfaces (GET /debug/requests flight recorder, POST /debug/profile
+    # jax.profiler capture): OFF by default — they expose request metadata and
+    # can write profile dumps, so only operator-controlled deployments should
+    # enable them (see README "Observability").
+    debug_endpoints: bool = False
     # -- self-healing supervision (PR 4) ----------------------------------
     # Hung-launch watchdog budget: clamp(base + multiplier * max_new_tokens
     # * per-token EWMA) seconds per device launch. The generous min floor
@@ -687,7 +693,12 @@ class TpuBackend(Backend):
         # can't express degrades to the valid-JSON mask, and compile errors /
         # the engine.grammar failpoint / constrained_decoding=False degrade to
         # unconstrained decode — post-hoc validation stays authoritative.
-        constraint = self._constraint_for(request.response_format)
+        _req_trace = current_trace()
+        if _req_trace is not None:
+            with _req_trace.phase("grammar_mask"):
+                constraint = self._constraint_for(request.response_format)
+        else:
+            constraint = self._constraint_for(request.response_format)
         # OpenAI semantics: top_logprobs only applies when logprobs is on.
         top_lp = request.top_logprobs if request.logprobs else None
         logit_bias = None
@@ -968,7 +979,8 @@ class TpuBackend(Backend):
             # The lambda re-resolves self.engine at call time, so when the
             # supervisor rebuilds mid-launch the replay lands on the NEW
             # engine — that is the whole recovery contract.
-            return self.supervisor.supervised_launch(
+            t0 = time.perf_counter()
+            out = self.supervisor.supervised_launch(
                 lambda: self.engine.generate_many(
                     specs,
                     max_new_tokens=max_new,
@@ -985,6 +997,10 @@ class TpuBackend(Backend):
                 rows=launch_rows,
                 max_new_tokens=max_new,
             )
+            # Per-launch decode wall time (host clock around the whole
+            # supervised launch — includes the fused paged-attention path).
+            LATENCY.observe("engine.decode_launch", time.perf_counter() - t0)
+            return out
 
         # Weight = this request's padded row count (the engine rounds n up to a
         # data-parallel multiple), so the scheduler's max_rows bound tracks the
@@ -1097,7 +1113,8 @@ class TpuBackend(Backend):
         # a few ms, so the scheduler's default 5 ms decode-admission window
         # would be a large relative latency cost here.
         pooled = self.scheduler.call_batched(
-            ("embed",), token_lists, run, weight=max(1, len(token_lists)), window=0.0
+            ("embed",), token_lists, run, weight=max(1, len(token_lists)),
+            window=0.0, trace_phase="embed",
         )
         return [[float(x) for x in row] for row in pooled]
 

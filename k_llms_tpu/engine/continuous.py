@@ -75,7 +75,13 @@ from ..types.wire import (
     EngineHungError,
     ServerDrainingError,
 )
-from ..utils.observability import FAILURE_EVENTS, GRAMMAR_EVENTS, RECOVERY_EVENTS
+from ..utils.observability import (
+    FAILURE_EVENTS,
+    GRAMMAR_EVENTS,
+    LATENCY,
+    RECOVERY_EVENTS,
+    current_trace,
+)
 from .engine import (
     GenerationResult,
     _poisoned_logits,
@@ -135,6 +141,11 @@ class _SlotRequest:
     # re-delivered.
     delivered_watermark: int = 0
     replays: int = 0
+    # Request trace captured on the SUBMITTING thread (the loop worker does
+    # not inherit contextvars), plus the enqueue timestamp for the
+    # queue-wait span/histogram. Both are host-side observability only.
+    trace: Optional[Any] = None
+    enqueued_at: float = 0.0
 
 
 class _StepHung(RuntimeError):
@@ -512,6 +523,8 @@ class ContinuousDecodeLoop:
                 top_p=1.0 if top_p is None else float(top_p),
                 seq=self._seq,
                 grammar=grammar,
+                trace=current_trace(),
+                enqueued_at=time.monotonic(),
             )
             self._seq += 1
             self._queue.append(req)
@@ -1059,13 +1072,23 @@ class ContinuousDecodeLoop:
                 FAILURE_EVENTS.record("scheduler.shed")
                 req.future.set_exception(req.budget.error("continuous queue"))
                 continue
+            if req.enqueued_at and not req.replays:
+                wait_s = max(0.0, time.monotonic() - req.enqueued_at)
+                LATENCY.observe("scheduler.queue_wait", wait_s)
+                if req.trace is not None:
+                    req.trace.add_phase("queue_wait", wait_s)
             if not self._built:
                 self._build_device_state()
             in_flight = self._active_mask.any()
             rows = [self._free.pop(0) for _ in range(req.n)]
             req.slots = rows
             try:
+                _admit_t0 = time.perf_counter()
                 self._admit_device(req, rows)
+                if req.trace is not None:
+                    req.trace.add_phase(
+                        "prefill", time.perf_counter() - _admit_t0
+                    )
             except PagePoolExhausted as e:
                 # Pages are a transient resource: in-flight rows free theirs
                 # as they retire, so park the head request and retry after the
@@ -1093,6 +1116,11 @@ class ContinuousDecodeLoop:
                 # but the request was already counted at first admission.
                 self._stats["replayed_rows"] += req.n
                 RECOVERY_EVENTS.record("continuous.replayed_rows", req.n)
+                if req.trace is not None:
+                    # One coherent trace per request: the SAME trace object
+                    # survives the rebuild, annotated rather than duplicated.
+                    req.trace.annotate("replayed")
+                    req.trace.bump("replayed_rows", req.n)
             else:
                 self._stats["admitted"] += 1
                 if in_flight:
@@ -1209,6 +1237,8 @@ class ContinuousDecodeLoop:
             req.finish[j] = "stop"
         req.sample_errors[j] = _quarantine_error()
         self._stats["quarantined_rows"] += 1
+        if req.trace is not None:
+            req.trace.bump("quarantined_rows")
 
     # -- paged slot management --------------------------------------------
 
@@ -1432,6 +1462,7 @@ class ContinuousDecodeLoop:
             outs = (tok, lp, bad) if new_g is None else (tok, lp, bad, new_g)
             return list(map(np.asarray, jax.device_get(outs)))
 
+        _step_t0 = time.perf_counter()
         if self.budget_model is not None:
             t0 = time.monotonic()
             try:
@@ -1450,6 +1481,10 @@ class ContinuousDecodeLoop:
             self.budget_model.observe_step(time.monotonic() - t0)
         else:
             fetched = _dispatch()
+        # Host wall time for the dispatched step (includes the by-design
+        # result readback); pure host-side observability, no extra syncs.
+        step_s = time.perf_counter() - _step_t0
+        LATENCY.observe("continuous.step", step_s)
         tok_np, lp_np, bad_np = fetched[0], fetched[1], fetched[2]
         quarantined = 0
         with self._lock:
@@ -1497,6 +1532,8 @@ class ContinuousDecodeLoop:
                 req = next(
                     r for r in self._active if r is not None and id(r) == rid
                 )
+                if req.trace is not None:
+                    req.trace.add_phase("decode", step_s)
                 if req.budget is not None and req.budget.should_abort():
                     self._abort_request(req)
                     continue

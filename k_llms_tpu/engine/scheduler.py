@@ -53,7 +53,12 @@ from ..analysis.lockcheck import make_condition
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, RateLimitError, ServerDrainingError
-from ..utils.observability import FAILURE_EVENTS, SPEC_EVENTS
+from ..utils.observability import (
+    FAILURE_EVENTS,
+    LATENCY,
+    SPEC_EVENTS,
+    current_trace,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -98,6 +103,9 @@ class _Item:
         "budget",
         "priority",
         "max_rows",
+        "trace",
+        "trace_phase",
+        "enqueued_at",
     )
 
     def __init__(
@@ -112,6 +120,7 @@ class _Item:
         budget=None,
         priority=0,
         max_rows=None,
+        trace_phase=None,
     ):
         self.future = future
         self.fn = fn
@@ -123,6 +132,14 @@ class _Item:
         self.budget = budget
         self.priority = priority
         self.max_rows = max_rows
+        # Captured on the submitting thread: the worker is a plain Thread and
+        # does not inherit contextvars, so the request trace must ride the
+        # item. ``trace_phase`` names the span the group's runner duration is
+        # attributed to (None for opaque closures — their inner device work
+        # traces itself).
+        self.trace = current_trace()
+        self.trace_phase = trace_phase
+        self.enqueued_at = time.monotonic()
 
 
 # Rolling window (seconds) over which the drain rate backing ``retry_after``
@@ -466,11 +483,26 @@ class EngineScheduler:
             if not live:
                 self._group_done(group, served=0, errors=0)
                 continue
+            # Admission-to-dequeue wait, observed here (outside self._cv —
+            # trace/histogram locks are leaves, never nested under the CV).
+            now = time.monotonic()
+            for it in live:
+                wait_s = max(0.0, now - it.enqueued_at)
+                LATENCY.observe("scheduler.queue_wait", wait_s)
+                if it.trace is not None:
+                    it.trace.add_phase("queue_wait", wait_s)
             try:
                 if live[0].batch_key is None:
                     live[0].future.set_result(live[0].fn())
                 else:
+                    t0 = time.perf_counter()
                     results = live[0].batch_fn([it.payload for it in live])
+                    launch_s = time.perf_counter() - t0
+                    # Per-launch attribution: every coalesced member shared
+                    # this device launch, so each trace gets the full span.
+                    for it in live:
+                        if it.trace is not None and it.trace_phase:
+                            it.trace.add_phase(it.trace_phase, launch_s)
                     if len(results) != len(live):  # pragma: no cover - runner bug
                         raise RuntimeError(
                             f"batch runner returned {len(results)} results "
@@ -654,6 +686,7 @@ class EngineScheduler:
         budget: Optional[RequestBudget] = None,
         priority: int = 0,
         max_rows: Optional[int] = None,
+        trace_phase: str = "decode",
     ) -> Future:
         """Enqueue ``payload`` for batched service. Items whose ``batch_key``
         matches the queue head's coalesce into ONE ``batch_fn(payloads)`` call
@@ -670,7 +703,10 @@ class EngineScheduler:
         important, default 0) only matters under overload: an arriving item
         may evict strictly-lower-priority queued items when the queue is full.
         ``max_rows`` is a per-item cap on the device rows of any group this
-        item joins — the backend's HBM memory model passes its estimate here."""
+        item joins — the backend's HBM memory model passes its estimate here.
+        ``trace_phase`` names the request-trace span the group's runner time
+        is attributed to ("decode" for generation launches; embeddings pass
+        "embed" so consolidation-time forwards don't read as decode)."""
         future: Future = Future()
         self._admit(
             _Item(
@@ -683,6 +719,7 @@ class EngineScheduler:
                 budget=budget,
                 priority=priority,
                 max_rows=max_rows,
+                trace_phase=trace_phase,
             )
         )
         return future
@@ -709,6 +746,7 @@ class EngineScheduler:
         budget: Optional[RequestBudget] = None,
         priority: int = 0,
         max_rows: Optional[int] = None,
+        trace_phase: str = "decode",
     ) -> Any:
         """Synchronous batched submit-and-wait (re-entrant like ``call``).
         Per-member failures surface here: if the runner returned an exception
@@ -729,6 +767,7 @@ class EngineScheduler:
             budget=budget,
             priority=priority,
             max_rows=max_rows,
+            trace_phase=trace_phase,
         ).result()
 
     # -- lifecycle & observability ----------------------------------------

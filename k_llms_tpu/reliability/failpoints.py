@@ -80,6 +80,12 @@ Injection sites wired in this package:
                            itself so crash containment must flush every queued
                            and in-flight future with a typed error and restart
                            the loop (bounded by ``max_rebuilds``)
+- ``serving.trace``      — evaluated when the tracer starts a request trace
+                           (``observability/trace.py``); the ``drop`` action
+                           degrades the tracer to no-op spans for that
+                           request (no timings, no flight record) while the
+                           request itself completes untouched — the contract
+                           under drill is that tracing never fails a request
 
 Actions (``FailSpec.action``):
 
@@ -120,6 +126,10 @@ Actions (``FailSpec.action``):
                        spec reads as what it simulates and so the env syntax
                        defaults to firing once (a crash on *every* iteration
                        is a rebuild storm, not a drill)
+- ``"drop"``         — no-op at the site itself; the tracer reads the spec
+                       and hands out a no-op trace (spans, annotations, and
+                       the flight record all degrade to nothing) while the
+                       request proceeds normally
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -139,8 +149,9 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="engine.grammar=raise:1"
     KLLMS_FAILPOINTS="continuous.step=hang:1:3"
     KLLMS_FAILPOINTS="continuous.worker=crash:1"
+    KLLMS_FAILPOINTS="serving.trace=drop:2"
 where the first numeric arg is ``times`` for
-raise/sleep/oom/corrupt/disconnect/fallback/crash specs (crash defaults to
+raise/sleep/oom/corrupt/disconnect/fallback/drop/crash specs (crash defaults to
 firing once), ``times[:delay]`` for hang, ``kill[:seed]`` for
 kill_samples/nan, ``kill`` (pages to drop) for leak, and ``member[:times]``
 for down/fail (replica sites are keyed by replica id).
@@ -176,6 +187,7 @@ SITES = (
     "engine.grammar",
     "continuous.step",
     "continuous.worker",
+    "serving.trace",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the
@@ -224,6 +236,7 @@ class FailSpec:
             "leak",
             "fallback",
             "crash",
+            "drop",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -361,7 +374,7 @@ def configure_from_env(env: Optional[str] = None) -> None:
             times = int(args[0]) if args else 1
             delay = float(args[1]) if len(args) > 1 else HANG_DELAY
             specs[site] = FailSpec(action="hang", times=times, delay=delay)
-        elif action in ("oom", "corrupt", "disconnect", "fallback"):
+        elif action in ("oom", "corrupt", "disconnect", "fallback", "drop"):
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action=action, times=times)
         elif action == "crash":

@@ -29,7 +29,7 @@ from ..consensus.similarity import SimilarityScorer
 from ..reliability.deadline import RequestBudget
 from ..types import KLLMsChatCompletion, KLLMsParsedChatCompletion
 from ..types.wire import InvalidRequestError
-from ..utils.observability import Trace
+from ..utils.observability import LATENCY, TRACER, Trace, use_trace
 
 import logging
 import os
@@ -40,13 +40,19 @@ logger = logging.getLogger(__name__)
 def _attach_trace(result, trace: Trace, backend=None):
     """Phase timings: logged at DEBUG always; attached to the response as a
     ``timings`` extension only when KLLMS_TRACE=1 (keeps the default wire
-    payload byte-identical to the reference contract). With a local backend
-    the trace also carries the engine-side serving stats (speculative
+    payload byte-identical to the reference contract). The payload is the
+    trace's full phase breakdown (queue_wait/prefill/decode/... accumulate
+    from the scheduler and decode loops) plus its trace_id, so a caller can
+    join a response to its ``/debug/requests`` flight record. With a local
+    backend the trace also carries the engine-side serving stats (speculative
     acceptance/fallback mode, prefix-cache hit mix, scheduler coalescing) —
     the numbers operators tune speculative/prefix/batch knobs against."""
     logger.debug("request timings: %s", trace.as_dict())
     if os.getenv("KLLMS_TRACE") == "1":
-        result.timings = trace.as_dict()
+        timings = dict(trace.as_dict())
+        if trace.trace_id:
+            timings["trace_id"] = trace.trace_id
+        result.timings = timings
         # TpuBackend attaches engine_stats to the completion payload at
         # generation time (race-free under concurrency: the spec stats ride
         # the GenerationResult, not shared engine state) and the wire types'
@@ -195,6 +201,13 @@ class ChatCompletionStream:
         self._completion: Optional[Any] = None
         self._closed = False
         self._exhausted = False
+        # Capture the request trace on the submitting thread (the worker is a
+        # plain Thread, which does NOT inherit contextvars) and remember
+        # ownership: an HTTP front door that started the trace finishes it;
+        # an in-process stream owns and finishes its own.
+        self.trace, self._owns_trace = TRACER.current_or_start()
+        self._t0 = time.monotonic()
+        self._first_delta_seen = False
         self._thread = threading.Thread(
             target=self._run, name="kllms-stream", daemon=True
         )
@@ -203,26 +216,55 @@ class ChatCompletionStream:
     # -- worker side ---------------------------------------------------------
 
     def _emit(self, sample_idx: int, delta: str) -> None:
+        if not self._first_delta_seen:
+            # TTFT: first streamed token for the whole n-way request,
+            # measured from stream construction (host wall clock).
+            self._first_delta_seen = True
+            ttft = time.monotonic() - self._t0
+            LATENCY.observe("request.ttft", ttft)
+            self.trace.annotate("ttft_s", round(ttft, 6))
         self._events.put(("delta", sample_idx, delta))
 
     def _run(self) -> None:
         try:
-            completion = self._backend.dispatch_chat_completion_stream(
-                self._request, self._emit
-            )
-            # Finish chunks can go out while consolidation is still running.
-            self._events.put(("sampled", completion))
-            result = consolidate_chat_completions(
-                completion,
-                self._scorer,
-                consensus_settings=self._settings,
-                llm_consensus_fn=self._llm_consensus_fn,
-                budget=self._request.budget,
-            )
+            # Re-enter the captured trace so the backend's scheduler /
+            # continuous-loop submissions on this thread attribute to it.
+            with use_trace(self.trace):
+                with self.trace.phase("sample"):
+                    completion = self._backend.dispatch_chat_completion_stream(
+                        self._request, self._emit
+                    )
+                # Finish chunks can go out while consolidation is still
+                # running.
+                self._events.put(("sampled", completion))
+                t0 = time.perf_counter()
+                with self.trace.phase("consolidate"):
+                    result = consolidate_chat_completions(
+                        completion,
+                        self._scorer,
+                        consensus_settings=self._settings,
+                        llm_consensus_fn=self._llm_consensus_fn,
+                        budget=self._request.budget,
+                    )
+                LATENCY.observe(
+                    "consensus.consolidate", time.perf_counter() - t0
+                )
             self._events.put(("final", result))
         except BaseException as e:  # surfaced on the consumer side
+            if self._owns_trace:
+                TRACER.finish(
+                    self.trace,
+                    route="stream",
+                    status="error",
+                    n=self._request.n,
+                    error=e,
+                )
             self._events.put(("error", e))
         else:
+            if self._owns_trace:
+                TRACER.finish(
+                    self.trace, route="stream", status="ok", n=self._request.n
+                )
             self._events.put(("done", None))
 
     # -- consumer side -------------------------------------------------------
@@ -308,6 +350,12 @@ class ChatCompletionStream:
             return
         self._closed = True
         self._exhausted = True
+        if self._owns_trace:
+            # No-op if the worker already finished the trace normally
+            # (mark_finished is first-caller-wins).
+            TRACER.finish(
+                self.trace, route="stream", status="aborted", n=self._request.n
+            )
         if self._request.budget is not None:
             self._request.budget.cancel()
         # Drain whatever the worker still enqueues so its puts never block
@@ -409,18 +457,38 @@ class Completions:
                 self._scorer(settings),
                 backend.llm_consensus,
             )
-        trace = Trace()
-        with trace.phase("sample"):
-            completion = self._wrapper.backend.dispatch_chat_completion(request)
-        with trace.phase("consolidate"):
-            result = consolidate_chat_completions(
-                completion,
-                self._scorer(settings),
-                consensus_settings=settings,
-                llm_consensus_fn=self._wrapper.backend.llm_consensus,
-                budget=request.budget,
-            )
-        return _attach_trace(result, trace, self._wrapper.backend)
+        # Adopt the front door's trace when one is bound to this context
+        # (asyncio.to_thread copies the contextvar into this thread);
+        # otherwise this call is the trace owner and must finish it.
+        trace, owned = TRACER.current_or_start()
+        try:
+            with use_trace(trace):
+                with trace.phase("sample"):
+                    completion = self._wrapper.backend.dispatch_chat_completion(
+                        request
+                    )
+                t0 = time.perf_counter()
+                with trace.phase("consolidate"):
+                    result = consolidate_chat_completions(
+                        completion,
+                        self._scorer(settings),
+                        consensus_settings=settings,
+                        llm_consensus_fn=self._wrapper.backend.llm_consensus,
+                        budget=request.budget,
+                    )
+                LATENCY.observe(
+                    "consensus.consolidate", time.perf_counter() - t0
+                )
+        except BaseException as e:
+            if owned:
+                TRACER.finish(
+                    trace, route="create", status="error", n=request.n, error=e
+                )
+            raise
+        result = _attach_trace(result, trace, self._wrapper.backend)
+        if owned:
+            TRACER.finish(trace, route="create", status="ok", n=request.n)
+        return result
 
     def parse(
         self,
@@ -458,19 +526,36 @@ class Completions:
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
             timeout=timeout,
         )
-        trace = Trace()
-        with trace.phase("sample"):
-            completion = self._wrapper.backend.dispatch_chat_completion(request)
-        with trace.phase("consolidate"):
-            result = consolidate_parsed_chat_completions(
-                completion,
-                self._scorer(settings),
-                consensus_settings=settings,
-                response_format=response_format,
-                llm_consensus_fn=self._wrapper.backend.llm_consensus,
-                budget=request.budget,
-            )
-        return _attach_trace(result, trace, self._wrapper.backend)
+        trace, owned = TRACER.current_or_start()
+        try:
+            with use_trace(trace):
+                with trace.phase("sample"):
+                    completion = self._wrapper.backend.dispatch_chat_completion(
+                        request
+                    )
+                t0 = time.perf_counter()
+                with trace.phase("consolidate"):
+                    result = consolidate_parsed_chat_completions(
+                        completion,
+                        self._scorer(settings),
+                        consensus_settings=settings,
+                        response_format=response_format,
+                        llm_consensus_fn=self._wrapper.backend.llm_consensus,
+                        budget=request.budget,
+                    )
+                LATENCY.observe(
+                    "consensus.consolidate", time.perf_counter() - t0
+                )
+        except BaseException as e:
+            if owned:
+                TRACER.finish(
+                    trace, route="parse", status="error", n=request.n, error=e
+                )
+            raise
+        result = _attach_trace(result, trace, self._wrapper.backend)
+        if owned:
+            TRACER.finish(trace, route="parse", status="ok", n=request.n)
+        return result
 
 
 class AsyncCompletions:

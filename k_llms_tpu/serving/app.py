@@ -16,7 +16,23 @@ Routes:
                                 then ``data: [DONE]``.
     GET  /healthz               scheduler lifecycle snapshot; 200 while the
                                 backend admits work, 503 once DRAINING/STOPPED.
-    GET  /metrics               text dump of every observability counter.
+    GET  /metrics               Prometheus text exposition (0.0.4): HELP/TYPE
+                                for every family — event counters, engine
+                                gauges, and the latency histograms
+                                (kllms_*_seconds _bucket/_sum/_count).
+    GET  /debug/requests        flight-recorder ring of recent request records
+                                (trace_id, phases, status, annotations).
+                                404 unless BackendConfig.debug_endpoints.
+    POST /debug/profile         on-demand jax.profiler capture (bounded
+                                duration). 404 unless debug_endpoints.
+
+Request tracing: a W3C ``traceparent`` header on POST /v1/chat/completions is
+ingested at this front door (one is generated when absent) and bound to the
+request context — ``asyncio.to_thread`` copies the contextvar into the thread
+running the client call, so scheduler admission, decode, and consolidation all
+attribute their spans to the caller's trace. The front door owns the trace:
+every terminal path (200, wire error, stream end/abort, disconnect) finishes
+it exactly once into the flight recorder.
 
 Typed wire errors map to HTTP: each KLLMsError carries ``status_code`` and an
 OpenAI-shaped ``as_wire()`` body, so 429/503/408/400 come out of the SAME
@@ -35,9 +51,12 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import tempfile
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..observability import prometheus as _prom
 from ..reliability import failpoints as _failpoints
 from ..types.wire import InvalidRequestError, KLLMsError, RateLimitError
 from ..utils import observability as _obs
@@ -68,6 +87,10 @@ _COUNTER_GROUPS = (
     ("grammar", "GRAMMAR_EVENTS"),
 )
 
+#: Upper bound for a POST /debug/profile capture; anything longer belongs in
+#: an offline KLLMS_PROFILE_DIR run, not a request handler.
+_PROFILE_MAX_S = 10.0
+
 
 class ServingApp:
     """ASGI 3 application over one KLLMs client."""
@@ -90,6 +113,10 @@ class ServingApp:
                 await self._healthz(send)
             elif method == "GET" and path == "/metrics":
                 await self._metrics(send)
+            elif method == "GET" and path == "/debug/requests":
+                await self._debug_requests(send)
+            elif method == "POST" and path == "/debug/profile":
+                await self._debug_profile(receive, send)
             else:
                 _obs.SERVE_EVENTS.record("request.unknown.404")
                 await _send_json(
@@ -141,15 +168,31 @@ class ServingApp:
 
     # -- GET /metrics ------------------------------------------------------
     async def _metrics(self, send) -> None:
-        lines: List[str] = []
+        # Proper Prometheus 0.0.4 exposition: every family carries HELP/TYPE
+        # lines, label values are escaped, and the latency histograms render
+        # the full _bucket/_sum/_count triple (cumulative, +Inf included).
+        families: List[Dict[str, Any]] = []
         for group, attr in _COUNTER_GROUPS:
             counters = getattr(_obs, attr, None)
             if counters is None:
                 continue
-            for event, count in sorted(counters.snapshot().items()):
-                lines.append(
-                    f'kllms_{group}_events_total{{event="{event}"}} {count}'
-                )
+            families.append(_prom.counter_family(
+                f"kllms_{group}_events_total",
+                f"{group} event counters "
+                "(vocabularies declared in utils/observability.py)",
+                [
+                    ({"event": event}, count)
+                    for event, count in sorted(counters.snapshot().items())
+                ],
+            ))
+        # Latency histograms (LATENCY): exactly-declared families export even
+        # at zero samples, so the scrape surface is stable from first poll.
+        for fam, snap in sorted(_obs.LATENCY.snapshot().items()):
+            families.append(_prom.histogram_family(
+                "kllms_" + fam.replace(".", "_") + "_seconds",
+                f"latency histogram for {fam} (seconds, log-spaced buckets)",
+                snap,
+            ))
         backend = getattr(self.client, "backend", None)
         cont = getattr(backend, "_continuous", None)
         if cont is not None:
@@ -158,10 +201,12 @@ class ServingApp:
                 # sections (page pool — exported below via health), strings
                 # (last_recovery_reason), and Nones, none of which are
                 # Prometheus sample values.
-                if isinstance(val, bool):
-                    lines.append(f"kllms_continuous_{key} {int(val)}")
-                elif isinstance(val, (int, float)):
-                    lines.append(f"kllms_continuous_{key} {val}")
+                if isinstance(val, (int, float)):
+                    families.append(_prom.gauge_family(
+                        f"kllms_continuous_{key}",
+                        f"continuous decode loop stat {key!r}",
+                        val,
+                    ))
         # HBM + paged-KV pool gauges from the backend's health snapshot (the
         # read doubles as a page-accounting invariant check).
         if backend is not None and hasattr(backend, "health"):
@@ -170,36 +215,146 @@ class ServingApp:
             for key, val in sorted(hbm.items()):
                 if key == "page_pool" and isinstance(val, dict):
                     for pk, pv in sorted(val.items()):
-                        lines.append(f"kllms_hbm_page_pool_{pk} {pv}")
-                elif isinstance(val, bool):
-                    lines.append(f"kllms_hbm_{key} {int(val)}")
+                        families.append(_prom.gauge_family(
+                            f"kllms_hbm_page_pool_{pk}",
+                            f"paged KV pool stat {pk!r}",
+                            pv,
+                        ))
                 elif isinstance(val, (int, float)) and val is not None:
-                    lines.append(f"kllms_hbm_{key} {val}")
+                    families.append(_prom.gauge_family(
+                        f"kllms_hbm_{key}", f"HBM budget stat {key!r}", val
+                    ))
             # Consensus cache gauges from the same snapshot: aggregate
             # hits/misses/entries/evictions across every scorer's caches.
             consensus = health.get("consensus") or {}
             for key, val in sorted((consensus.get("cache") or {}).items()):
-                lines.append(f"kllms_consensus_cache_{key} {val}")
+                families.append(_prom.gauge_family(
+                    f"kllms_consensus_cache_{key}",
+                    f"consensus similarity/embedding cache stat {key!r}",
+                    val,
+                ))
             if "device_consensus" in consensus:
-                lines.append(
-                    f"kllms_consensus_device_enabled {int(bool(consensus['device_consensus']))}"
-                )
+                families.append(_prom.gauge_family(
+                    "kllms_consensus_device_enabled",
+                    "1 when the batched on-device consensus kernels are active",
+                    bool(consensus["device_consensus"]),
+                ))
             # Grammar-compile cache gauges + the constrained-decoding switch:
             # one compile per (schema, vocab) fleet-wide, so hits/misses here
             # are the direct measure of the cache paying for itself.
             grammar = health.get("grammar") or {}
             for key, val in sorted((grammar.get("cache") or {}).items()):
-                lines.append(f"kllms_grammar_cache_{key} {val}")
+                families.append(_prom.gauge_family(
+                    f"kllms_grammar_cache_{key}",
+                    f"compiled grammar-mask cache stat {key!r}",
+                    val,
+                ))
             if "enabled" in grammar:
-                lines.append(
-                    f"kllms_grammar_enabled {int(bool(grammar['enabled']))}"
-                )
-        body = ("\n".join(lines) + "\n").encode()
+                families.append(_prom.gauge_family(
+                    "kllms_grammar_enabled",
+                    "1 when schema-constrained decoding is enabled",
+                    bool(grammar["enabled"]),
+                ))
+        body = _prom.render_families(families).encode()
         _obs.SERVE_EVENTS.record("request.metrics.200")
         await _send_bytes(send, 200, body, content_type=b"text/plain; version=0.0.4")
 
+    # -- GET /debug/requests + POST /debug/profile -------------------------
+    def _debug_enabled(self) -> bool:
+        backend = getattr(self.client, "backend", None)
+        cfg = getattr(backend, "backend_config", None)
+        return bool(getattr(cfg, "debug_endpoints", False))
+
+    async def _debug_denied(self, send) -> None:
+        # Indistinguishable from an unknown route: debug surfaces are off by
+        # default (BackendConfig.debug_endpoints) and shouldn't advertise
+        # their existence to unauthorized scrapers.
+        _obs.SERVE_EVENTS.record("request.debug.404")
+        await _send_json(
+            send, 404,
+            _error_body("not found", "invalid_request_error", "not_found"),
+        )
+
+    async def _debug_requests(self, send) -> None:
+        if not self._debug_enabled():
+            await self._debug_denied(send)
+            return
+        recorder = _obs.FLIGHT_RECORDER
+        _obs.SERVE_EVENTS.record("request.debug.200")
+        await _send_json(
+            send, 200,
+            {"requests": recorder.snapshot(), **recorder.stats()},
+        )
+
+    async def _debug_profile(self, receive, send) -> None:
+        if not self._debug_enabled():
+            await self._debug_denied(send)
+            return
+        body = await _read_body(receive)
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("payload must be a JSON object")
+            duration = float(payload.get("duration_s", 1.0))
+        except ValueError as e:
+            _obs.SERVE_EVENTS.record("request.debug.400")
+            await _send_json(
+                send, 400,
+                _error_body(
+                    f"invalid profile request: {e}",
+                    "invalid_request_error", None,
+                ),
+            )
+            return
+        # Bounded capture: clamp instead of erroring so an over-eager
+        # duration still yields a usable (shorter) profile.
+        duration = min(max(duration, 0.01), _PROFILE_MAX_S)
+        log_dir = str(
+            payload.get("log_dir")
+            or tempfile.mkdtemp(prefix="kllms-profile-")
+        )
+
+        def _capture() -> None:
+            with _obs.device_profiler(log_dir):
+                time.sleep(duration)
+
+        await asyncio.to_thread(_capture)
+        _obs.SERVE_EVENTS.record("request.debug.200")
+        await _send_json(
+            send, 200, {"log_dir": log_dir, "duration_s": duration}
+        )
+
     # -- POST /v1/chat/completions ----------------------------------------
     async def _chat(self, scope, receive, send) -> None:
+        # Trace ownership lives at the front door: ingest the caller's W3C
+        # context (or generate one), bind it for every downstream
+        # await/to_thread of this request, and finish it — exactly once —
+        # on whichever terminal path the request takes.
+        traceparent = None
+        for key, value in scope.get("headers") or []:
+            if key == b"traceparent":
+                traceparent = value.decode("latin-1")
+                break
+        trace = _obs.TRACER.start(traceparent)
+        outcome: Dict[str, Any] = {"status": 500, "n": None, "error": None}
+        try:
+            with _obs.use_trace(trace):
+                await self._chat_inner(receive, send, outcome)
+        except ClientDisconnected:
+            outcome["status"] = "disconnect"
+            raise
+        finally:
+            _obs.TRACER.finish(
+                trace,
+                route="chat",
+                status=outcome["status"],
+                n=outcome["n"],
+                error=outcome["error"],
+            )
+
+    async def _chat_inner(
+        self, receive, send, outcome: Dict[str, Any]
+    ) -> None:
         body = await _read_body(receive)
         try:
             payload = json.loads(body or b"{}")
@@ -207,6 +362,7 @@ class ServingApp:
                 raise ValueError("payload must be a JSON object")
         except ValueError as e:
             _obs.SERVE_EVENTS.record("request.chat.400")
+            outcome["status"] = 400
             await _send_json(
                 send, 400,
                 _error_body(f"invalid JSON body: {e}", "invalid_request_error", None),
@@ -215,6 +371,7 @@ class ServingApp:
         messages = payload.get("messages")
         if not isinstance(messages, list) or not messages:
             _obs.SERVE_EVENTS.record("request.chat.400")
+            outcome["status"] = 400
             await _send_json(
                 send, 400,
                 _error_body(
@@ -225,6 +382,7 @@ class ServingApp:
             return
         stream = bool(payload.get("stream", False))
         params = {k: payload[k] for k in _CREATE_KEYS if payload.get(k) is not None}
+        outcome["n"] = payload.get("n")
 
         # Fault injection at the front door. raise/sleep actions fire inside;
         # a returned ``disconnect`` spec simulates the client dropping the
@@ -232,7 +390,8 @@ class ServingApp:
         try:
             spec = _failpoints.fire("serving.request")
         except Exception as e:
-            await self._send_error(send, e, route="chat")
+            outcome["status"] = await self._send_error(send, e, route="chat")
+            outcome["error"] = e
             return
         simulate_disconnect = (
             spec is not None and getattr(spec, "action", None) == "disconnect"
@@ -244,23 +403,33 @@ class ServingApp:
                     self.client.chat.completions.create, **params
                 )
             except Exception as e:
-                await self._send_error(send, e, route="chat")
+                outcome["status"] = await self._send_error(send, e, route="chat")
+                outcome["error"] = e
                 return
             _obs.SERVE_EVENTS.record("request.chat.200")
+            outcome["status"] = 200
             await _send_json(send, 200, completion.model_dump(mode="json"))
             return
 
-        await self._chat_stream(receive, send, params, simulate_disconnect)
+        await self._chat_stream(
+            receive, send, params, simulate_disconnect, outcome
+        )
 
     async def _chat_stream(
-        self, receive, send, params: Dict[str, Any], simulate_disconnect: bool
+        self,
+        receive,
+        send,
+        params: Dict[str, Any],
+        simulate_disconnect: bool,
+        outcome: Dict[str, Any],
     ) -> None:
         try:
             stream_obj = await asyncio.to_thread(
                 self.client.chat.completions.create, stream=True, **params
             )
         except Exception as e:
-            await self._send_error(send, e, route="chat")
+            outcome["status"] = await self._send_error(send, e, route="chat")
+            outcome["error"] = e
             return
         _obs.STREAM_EVENTS.record("streams.opened")
 
@@ -326,13 +495,17 @@ class ServingApp:
                     _obs.STREAM_EVENTS.record("streams.pings")
                 if disconnect_task in done:
                     get_task.cancel()
+                    outcome["status"] = "disconnect"
                     await self._abort_stream(stream_obj, "client disconnected")
                     return
                 kind, value = get_task.result()
                 if kind == "error":
                     e = value
+                    outcome["error"] = e
                     if not started:
-                        await self._send_error(send, e, route="chat")
+                        outcome["status"] = await self._send_error(
+                            send, e, route="chat"
+                        )
                     else:
                         # Headers are on the wire; the error rides the stream.
                         wire = (
@@ -340,6 +513,7 @@ class ServingApp:
                             if isinstance(e, KLLMsError)
                             else {"message": str(e), "type": "server_error"}
                         )
+                        outcome["status"] = "stream_error"
                         await send({
                             "type": "http.response.body",
                             "body": sse.format_event({"error": wire}) + sse.DONE,
@@ -348,6 +522,7 @@ class ServingApp:
                     _obs.STREAM_EVENTS.record("streams.aborted")
                     return
                 if kind == "end":
+                    outcome["status"] = 200
                     await send({
                         "type": "http.response.body",
                         "body": sse.DONE,
@@ -376,6 +551,7 @@ class ServingApp:
                 if simulate_disconnect and deltas_sent >= 1:
                     # Injected client drop: behave exactly as if http.disconnect
                     # arrived now — cancel the decode, stop writing.
+                    outcome["status"] = "disconnect"
                     _obs.SERVE_EVENTS.record("request.disconnect")
                     await self._abort_stream(
                         stream_obj, "injected disconnect (failpoint)",
@@ -402,7 +578,7 @@ class ServingApp:
         # the continuous loop's budget check) then retires the decode rows.
         await asyncio.to_thread(stream_obj.close)
 
-    async def _send_error(self, send, e: Exception, route: str) -> None:
+    async def _send_error(self, send, e: Exception, route: str) -> int:
         if isinstance(e, KLLMsError):
             status = e.status_code
             body = e.as_wire()  # already the full {"error": {...}} envelope
@@ -415,6 +591,7 @@ class ServingApp:
             headers.append((b"retry-after", str(max(1, int(e.retry_after))).encode()))
         _obs.SERVE_EVENTS.record(f"request.{route}.{status}")
         await _send_json(send, status, body, extra_headers=headers)
+        return status
 
 
 def create_app(

@@ -1,10 +1,14 @@
 """Tracing, metrics, and logging.
 
 The reference has no tracing/profiling (SURVEY.md §5) — only a DEBUG logger
-gated on ``ENV_NAME=dev`` (`consensus_utils.py:45-50`), which we keep. Added
-here: per-phase wall timers for the request pipeline (sample / align+consensus
-run host-side; decode runs on device), a ``jax.profiler`` wrapper for device
-traces (Perfetto-compatible dumps), and consensus-confidence histograms.
+gated on ``ENV_NAME=dev`` (`consensus_utils.py:45-50`), which we keep. The
+request-scoped tracing/histogram/flight-recorder layer lives in
+``k_llms_tpu/observability/`` and is re-exported here; this module keeps the
+``EventCounters`` groups (the process-wide counter vocabularies), the
+``jax.profiler`` wrapper for device traces, and consensus-confidence
+histograms. ``Trace`` is now an alias of the thread-safe ``RequestTrace``
+(the old two-phase timer mutated ``durations`` without a lock; the stream
+sink thread and the caller can time phases concurrently).
 """
 
 from __future__ import annotations
@@ -13,10 +17,29 @@ import contextlib
 import fnmatch
 import logging
 import os
-import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..analysis.lockcheck import make_lock
+from ..observability import (  # noqa: F401  (re-exported surface)
+    FLIGHT_RECORDER,
+    FlightRecorder,
+    LATENCY,
+    LatencyHistograms,
+    NOOP_TRACE,
+    NoopTrace,
+    RequestTrace,
+    Span,
+    TRACER,
+    Tracer,
+    current_trace,
+    format_traceparent,
+    parse_traceparent,
+    use_trace,
+)
+
+#: Back-compat alias: the request-phase timer existing call sites construct
+#: directly. Same ``phase()``/``as_dict()`` surface, now lock-guarded.
+Trace = RequestTrace
 
 
 def configure_logging() -> logging.Logger:
@@ -27,26 +50,6 @@ def configure_logging() -> logging.Logger:
     else:
         logger.setLevel(logging.INFO)
     return logger
-
-
-class Trace:
-    """Wall-clock phase timers for one request: trace.phase("sample") blocks."""
-
-    def __init__(self) -> None:
-        self.durations: Dict[str, float] = {}
-
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.durations[name] = self.durations.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
-
-    def as_dict(self) -> Dict[str, float]:
-        return {k: round(v, 6) for k, v in self.durations.items()}
 
 
 @contextlib.contextmanager

@@ -1,7 +1,7 @@
-"""counter-hygiene fixture metrics surface: one group missing on purpose."""
+"""counter-hygiene fixture metrics surface: one group of each missing."""
 
-from ..utils.observability import BETA_EVENTS
+from ..utils.observability import BETA_EVENTS, DELTA_HIST
 
 
 def metrics():
-    return {"beta": BETA_EVENTS.declared}
+    return {"beta": BETA_EVENTS.declared, "delta": DELTA_HIST.declared}

@@ -9,9 +9,24 @@ class EventCounters:
         pass
 
 
+class LatencyHistograms:
+    def __init__(self, declared=None, buckets=()):
+        self.declared = tuple(declared or ())
+
+    def observe(self, name, seconds):
+        pass
+
+
 ALPHA_EVENTS = EventCounters()  # no declared= vocabulary
 
 BETA_EVENTS = EventCounters(declared=(
     "a.b",
     "stale.name",  # declared but never recorded anywhere
+))
+
+GAMMA_HIST = LatencyHistograms()  # no declared= vocabulary
+
+DELTA_HIST = LatencyHistograms(declared=(
+    "h.a",
+    "stale.hist",  # declared but never observed anywhere
 ))

@@ -1,8 +1,10 @@
-"""counter-hygiene fixture call sites: literals and an f-string family."""
+"""counter-hygiene fixture call sites: literals and f-string families."""
 
-from .utils.observability import EVENTS
+from .utils.observability import EVENTS, HIST
 
 
 def work(route):
     EVENTS.record("a.b")
     EVENTS.record(f"keyed.{route}")
+    HIST.observe("h.a", 0.1)
+    HIST.observe(f"hkeyed.{route}", 0.1)

@@ -1,7 +1,7 @@
 """counter-hygiene fixture metrics surface: every group exported."""
 
-from ..utils.observability import EVENTS
+from ..utils.observability import EVENTS, HIST
 
 
 def metrics():
-    return {"events": EVENTS.declared}
+    return {"events": EVENTS.declared, "latency": HIST.declared}

@@ -1,4 +1,4 @@
-"""counter-hygiene fixture groups: declared vocabulary covers every record."""
+"""counter-hygiene fixture groups: declared vocabularies cover every site."""
 
 
 class EventCounters:
@@ -9,7 +9,20 @@ class EventCounters:
         pass
 
 
+class LatencyHistograms:
+    def __init__(self, declared=None, buckets=()):
+        self.declared = tuple(declared or ())
+
+    def observe(self, name, seconds):
+        pass
+
+
 EVENTS = EventCounters(declared=(
     "a.b",
     "keyed.*",  # f-string family: keyed.<route>
+))
+
+HIST = LatencyHistograms(declared=(
+    "h.a",
+    "hkeyed.*",  # f-string family: hkeyed.<route>
 ))

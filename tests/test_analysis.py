@@ -230,11 +230,17 @@ def test_failpoint_coverage_good_fixture_is_clean():
 
 def test_counter_hygiene_bad_fixture():
     msgs = messages(run_fixture("counter-hygiene", "counter-hygiene/bad"))
-    assert len(msgs) == 4
-    assert sum("without declared=" in m for m in msgs) == 1
+    assert len(msgs) == 8
+    # Counter group findings.
+    assert sum("counter group" in m and "without declared=" in m for m in msgs) == 1
     assert sum("'a.typo'" in m for m in msgs) == 1
     assert sum("'stale.name'" in m and "never" in m for m in msgs) == 1
     assert sum("not surfaced" in m and "ALPHA_EVENTS" in m for m in msgs) == 1
+    # Histogram group findings mirror the counter contract.
+    assert sum("histogram group" in m and "without declared=" in m for m in msgs) == 1
+    assert sum("'h.typo'" in m for m in msgs) == 1
+    assert sum("'stale.hist'" in m and "never observed" in m for m in msgs) == 1
+    assert sum("not surfaced" in m and "GAMMA_HIST" in m for m in msgs) == 1
 
 
 def test_counter_hygiene_good_fixture_is_clean():
