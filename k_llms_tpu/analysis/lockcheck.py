@@ -29,16 +29,47 @@ surface the bug. Call :func:`assert_clean` at the end of a test/soak.
 Lock names are canonical ids shared with the static ``lock-order`` rule
 (``engine.scheduler``, ``engine.kv_pool``...), so a runtime violation and a
 lint finding point at the same lock.
+
+Racecheck — the lockset sanitizer (``KLLMS_RACECHECK=1``)
+---------------------------------------------------------
+
+The second sanitizer the factories feed is an Eraser-style data-race
+detector over the *fields* of lock-owning objects, the runtime twin of the
+static ``guarded-by`` rule family:
+
+- When ``KLLMS_RACECHECK=1``, every ``make_lock/make_rlock/make_condition``
+  call made from a method (``self`` in the caller's frame) registers its
+  owner via :func:`shared_state`: the owner's class is swapped for a tracked
+  subclass whose ``__setattr__``/``__getattribute__`` observe every instance
+  -dict field access together with the set of checked locks the accessing
+  thread holds.
+- Each field keeps a candidate lockset refined by intersection across
+  threads (Eraser's algorithm). The first thread to touch a field owns it
+  exclusively — initialization writes are exempt. Once a second thread
+  joins, reads move the field to *shared* and writes to *shared-modified*;
+  a shared-modified field whose candidate lockset goes empty is a race, and
+  the violation records BOTH access stacks (the one that emptied the set
+  and the previous access).
+- Fields that are unsynchronized by design carry a static
+  ``# kllms: unguarded — reason`` annotation AND a runtime
+  :func:`race_exempt` call next to it, so the two sides never disagree.
+
+Racecheck violations flow through the same :func:`violations` /
+:func:`assert_clean` surface, so any soak that already asserts lockcheck
+cleanliness becomes a race detector by exporting one more env var. With
+``KLLMS_RACECHECK`` unset, :func:`shared_state` and :func:`race_exempt`
+return before allocating anything: zero instrumentation objects exist.
 """
 
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 import traceback
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple, Union
 
 __all__ = [
     "LockCheckError",
@@ -49,7 +80,10 @@ __all__ = [
     "make_lock",
     "make_rlock",
     "note_device_dispatch",
+    "race_exempt",
+    "racecheck_enabled",
     "reset_state",
+    "shared_state",
     "violations",
 ]
 
@@ -58,6 +92,10 @@ _TRUE = ("1", "true", "yes", "on")
 
 def lockcheck_enabled() -> bool:
     return os.getenv("KLLMS_LOCKCHECK", "").strip().lower() in _TRUE
+
+
+def racecheck_enabled() -> bool:
+    return os.getenv("KLLMS_RACECHECK", "").strip().lower() in _TRUE
 
 
 class LockCheckError(AssertionError):
@@ -283,6 +321,230 @@ class _CheckedCondition(_CheckedBase):
 
 
 # ---------------------------------------------------------------------------
+# racecheck: Eraser-style lockset sanitizer over lock-owning objects
+# ---------------------------------------------------------------------------
+
+# Values that are synchronization machinery (or per-thread by construction),
+# never shared data — accesses to them carry no lockset signal.
+_EXEMPT_VALUE_TYPES = (
+    _CheckedBase,
+    threading.local,
+    type(threading.Lock()),
+    type(threading.RLock()),
+    threading.Condition,
+    threading.Event,
+    threading.Thread,
+    threading.Semaphore,
+)
+
+# Original class -> tracked subclass (same name, interposed accessors). A
+# cache, not per-object state: one entry per lock-owning *class*.
+_tracked_classes: Dict[type, type] = {}
+
+# Process-unique thread identity. ``threading.get_ident()`` is recycled the
+# moment a thread exits, so a field written by a dead thread and then by its
+# ident-reusing successor would look single-threaded and never leave the
+# exclusive state. A serial handed out once per thread cannot collide.
+_thread_serial_next = [0]
+
+
+def _thread_serial() -> int:
+    s = getattr(_tls, "race_serial", None)
+    if s is None:
+        with _state_lock:
+            _thread_serial_next[0] += 1
+            s = _thread_serial_next[0]
+        _tls.race_serial = s
+    return s
+
+
+@dataclass
+class _FieldState:
+    """Eraser state machine for one field of one tracked object.
+
+    ``state``: ``exclusive`` (single thread so far — the first-thread
+    exemption that keeps initialization silent) -> ``shared`` (second
+    thread read it) -> ``shared-modified`` (any thread wrote it after it
+    went multi-thread). ``lockset`` is the candidate-guard intersection,
+    started at the first cross-thread access; ``None`` means "all locks"
+    (still exclusive). A shared-modified field with an empty lockset is a
+    race, reported once with both access stacks."""
+
+    state: str
+    first_thread: int
+    lockset: Optional[FrozenSet[str]] = None
+    last_stack: Tuple[Tuple[str, int, str], ...] = ()
+    last_thread: str = ""
+    last_kind: str = ""
+    reported: bool = False
+
+
+def _mini_stack() -> Tuple[Tuple[str, int, str], ...]:
+    """Cheap raw-frame capture (no string formatting on the access path —
+    frames are only rendered if a violation is reported)."""
+    out: List[Tuple[str, int, str]] = []
+    f = sys._getframe(1)
+    while f is not None and len(out) < 5:
+        co = f.f_code
+        if co.co_filename != _THIS_FILE:
+            out.append((co.co_filename, f.f_lineno, co.co_name))
+        f = f.f_back
+    return tuple(out)
+
+
+def _fmt_stack(stack: Tuple[Tuple[str, int, str], ...]) -> str:
+    if not stack:
+        return "<unknown>"
+    return " <- ".join(f"{fn}:{ln} in {name}" for fn, ln, name in stack)
+
+
+def _track_name(name: str) -> bool:
+    return not (name.startswith("__") or name.startswith("_kllms"))
+
+
+def _race_access(owner: Any, name: str, kind: str) -> None:
+    d = object.__getattribute__(owner, "__dict__")
+    fields = d.get("_kllms_race_fields")
+    if fields is None:
+        return
+    exempt = d.get("_kllms_race_exempt")
+    if exempt is not None and name in exempt:
+        return
+    tid = _thread_serial()
+    held_entries = getattr(_tls, "held", None) or ()
+    held = frozenset(e.name for e in held_entries)
+    stack = _mini_stack()
+    tname = threading.current_thread().name
+    with _state_lock:
+        st = fields.get(name)
+        if st is None:
+            fields[name] = _FieldState(
+                state="exclusive",
+                first_thread=tid,
+                last_stack=stack,
+                last_thread=tname,
+                last_kind=kind,
+            )
+            return
+        if st.state == "exclusive" and tid == st.first_thread:
+            st.last_stack, st.last_thread, st.last_kind = stack, tname, kind
+            return
+        if st.state == "exclusive":
+            st.state = "shared-modified" if kind == "write" else "shared"
+            st.lockset = held
+        else:
+            st.lockset = held if st.lockset is None else (st.lockset & held)
+            if kind == "write":
+                st.state = "shared-modified"
+        if (
+            st.state == "shared-modified"
+            and st.lockset is not None
+            and not st.lockset
+            and not st.reported
+        ):
+            st.reported = True
+            _violations_append_locked(
+                f"racecheck: {type(owner).__name__}.{name} has an empty "
+                f"candidate lockset under multi-thread access (owner "
+                f"registered via lock {d.get('_kllms_race_owner', '?')!r})\n"
+                f"  access A [{st.last_kind} by {st.last_thread}]: "
+                f"{_fmt_stack(st.last_stack)}\n"
+                f"  access B [{kind} by {tname}]: {_fmt_stack(stack)}"
+            )
+        st.last_stack, st.last_thread, st.last_kind = stack, tname, kind
+
+
+def _make_tracked(cls: type) -> type:
+    """Subclass *cls* (same name) with accessors that feed the sanitizer.
+    Only instance-dict data fields count: methods, properties, dunders, and
+    lock-valued attributes are filtered on the access path."""
+
+    def __setattr__(self: Any, name: str, value: Any, _cls: type = cls) -> None:
+        if _track_name(name) and not isinstance(value, _EXEMPT_VALUE_TYPES):
+            _race_access(self, name, "write")
+        _cls.__setattr__(self, name, value)
+
+    def __getattribute__(self: Any, name: str, _cls: type = cls) -> Any:
+        value = _cls.__getattribute__(self, name)
+        if _track_name(name):
+            d = object.__getattribute__(self, "__dict__")
+            if name in d and not isinstance(value, _EXEMPT_VALUE_TYPES):
+                _race_access(self, name, "read")
+        return value
+
+    return type(
+        cls.__name__,
+        (cls,),
+        {
+            "__setattr__": __setattr__,
+            "__getattribute__": __getattribute__,
+            "__module__": cls.__module__,
+            "_kllms_is_tracked": True,
+        },
+    )
+
+
+def shared_state(owner: Any, name: str) -> None:
+    """Register *owner*'s fields for lockset tracking (no-op unless
+    ``KLLMS_RACECHECK=1``). Called automatically by the lock factories when
+    they can see their owner (``self`` in the calling frame); public so
+    tests and lock-less shared objects can register explicitly. ``name`` is
+    the canonical lock id used to attribute violations."""
+    if owner is None or not racecheck_enabled():
+        return
+    cls = type(owner)
+    if not cls.__dict__.get("_kllms_is_tracked"):
+        try:
+            object.__getattribute__(owner, "__dict__")
+        except AttributeError:  # __slots__-only object: cannot interpose
+            return
+        tracked = _tracked_classes.get(cls)
+        if tracked is None:
+            tracked = _make_tracked(cls)
+            _tracked_classes[cls] = tracked
+        try:
+            object.__setattr__(owner, "__class__", tracked)
+        except TypeError:  # incompatible layout (extension type, slots)
+            return
+    d = object.__getattribute__(owner, "__dict__")
+    d.setdefault("_kllms_race_fields", {})
+    d.setdefault("_kllms_race_owner", name)
+
+
+def race_exempt(owner: Any, *names: str) -> None:
+    """Exclude fields of *owner* from lockset tracking — the runtime twin of
+    the static ``# kllms: unguarded — reason`` annotation. Call it right
+    next to the annotated assignment so the two exemption lists cannot
+    drift. No-op (and allocation-free) unless ``KLLMS_RACECHECK=1``."""
+    if owner is None or not racecheck_enabled():
+        return
+    d = object.__getattribute__(owner, "__dict__")
+    exempt = d.get("_kllms_race_exempt")
+    if exempt is None:
+        exempt = set()
+        d["_kllms_race_exempt"] = exempt
+    exempt.update(names)
+    fields = d.get("_kllms_race_fields")
+    if fields is not None:
+        with _state_lock:
+            for n in names:
+                fields.pop(n, None)
+
+
+def _auto_register(name: str) -> None:
+    # The idiomatic factory call is ``self._lock = make_lock(...)`` inside
+    # ``__init__``; the owner is the ``self`` two frames up. Module-level
+    # locks (no ``self``) simply have no fields to track.
+    try:
+        frame = sys._getframe(2)
+    except ValueError:  # pragma: no cover - interpreter without frames
+        return
+    owner = frame.f_locals.get("self")
+    if owner is not None:
+        shared_state(owner, name)
+
+
+# ---------------------------------------------------------------------------
 # factories + dispatch marker + reporting
 # ---------------------------------------------------------------------------
 
@@ -290,9 +552,12 @@ class _CheckedCondition(_CheckedBase):
 def make_lock(
     name: str, *, allow_dispatch: bool = False
 ) -> Union[threading.Lock, _CheckedLock]:
-    """A ``threading.Lock`` (or its checked twin under KLLMS_LOCKCHECK=1).
+    """A ``threading.Lock`` (or its checked twin under KLLMS_LOCKCHECK=1 /
+    KLLMS_RACECHECK=1 — the lockset sanitizer needs held-lock tracking too).
     ``name`` is the canonical id shared with the static lock-order rule."""
-    if not lockcheck_enabled():
+    if racecheck_enabled():
+        _auto_register(name)
+    elif not lockcheck_enabled():
         return threading.Lock()
     return _CheckedLock(name, allow_dispatch)
 
@@ -300,7 +565,9 @@ def make_lock(
 def make_rlock(
     name: str, *, allow_dispatch: bool = False
 ) -> Union[threading.RLock, _CheckedRLock]:
-    if not lockcheck_enabled():
+    if racecheck_enabled():
+        _auto_register(name)
+    elif not lockcheck_enabled():
         return threading.RLock()
     return _CheckedRLock(name, allow_dispatch)
 
@@ -308,7 +575,9 @@ def make_rlock(
 def make_condition(
     name: str, lock: Optional[Any] = None, *, allow_dispatch: bool = False
 ) -> Union[threading.Condition, _CheckedCondition]:
-    if not lockcheck_enabled():
+    if racecheck_enabled():
+        _auto_register(name)
+    elif not lockcheck_enabled():
         inner = lock._inner if isinstance(lock, _CheckedBase) else lock
         return threading.Condition(inner)
     return _CheckedCondition(name, allow_dispatch, lock)
@@ -342,7 +611,10 @@ def graph() -> Dict[Tuple[str, str], str]:
 
 def reset_state() -> None:
     """Clear the global graph and violation log (test isolation). Held-lock
-    stacks are thread-local and owned by live threads; they are not touched."""
+    stacks are thread-local and owned by live threads; they are not touched.
+    Racecheck field states live on the tracked instances themselves and die
+    with them — only the recorded violations are global, and those clear
+    here."""
     with _state_lock:
         _graph.clear()
         _violations.clear()

@@ -2,4 +2,4 @@
 with :data:`k_llms_tpu.analysis.framework.RULES` via the ``@register``
 decorators — the framework imports it lazily from ``_ensure_rules_loaded``."""
 
-from . import contracts, hotpath, locks  # noqa: F401
+from . import contracts, guardedby, hotpath, locks  # noqa: F401

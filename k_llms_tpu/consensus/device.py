@@ -464,6 +464,7 @@ class DeviceSimilarityScorer(SimilarityScorer):
         # Persistent bucket-level pair cache: key = sorted unique strings of a
         # bucket, value = the scored pair map. Warm repeats skip the device.
         self._bucket_cache = TTLCache(maxsize=4096, ttl=300.0, name="pairs")
+        # kllms: unguarded — threading.local: per-thread storage by design
         self._tls = threading.local()
         # Chip-busy gate: taken non-blocking, and held across the batched
         # similarity kernel dispatch on purpose — that hold IS the gate.

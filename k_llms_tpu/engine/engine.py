@@ -33,7 +33,7 @@ from jax.experimental import io_callback
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..analysis.lockcheck import make_rlock, note_device_dispatch
+from ..analysis.lockcheck import make_rlock, note_device_dispatch, race_exempt
 from ..models.config import ModelConfig, get_config
 from ..models.llama import (
     KVCache,
@@ -440,7 +440,31 @@ class LocalEngine:
         self._prefix_entries: "OrderedDict[Tuple[int, ...], Tuple[Any, KVCache, int, Any]]" = (
             OrderedDict()
         )
+        # Best-effort cache counters: a lost increment under concurrent
+        # routes skews stats, never correctness; readers snapshot via dict().
+        # kllms: unguarded — best-effort counters; losses skew stats only
         self.prefix_cache_stats = {"hits": 0, "partial_hits": 0, "misses": 0}
+        # Speculative-decode counters, same contract as prefix_cache_stats:
+        # published whole-object after each spec decode, snapshot via dict().
+        # kllms: unguarded — best-effort counters; losses skew stats only
+        self.spec_stats: Dict[str, Any] = {}
+        # Abort-flag budgets and streaming token sinks for in-flight decodes:
+        # published/retracted by the single generating thread; the jitted
+        # io_callback reader tolerates a stale or missing snapshot.
+        # kllms: unguarded — single-writer publish; io_callback reads tolerate staleness
+        self._active_budgets: Dict[int, Any] = {}
+        # kllms: unguarded — single-writer publish; io_callback reads tolerate staleness
+        self._active_token_sinks: Dict[int, Any] = {}
+        # Runtime twin of the annotations above: the lockset sanitizer
+        # (KLLMS_RACECHECK=1) skips exactly the fields the static rule skips.
+        race_exempt(
+            self,
+            "prefix_cache_stats",
+            "spec_stats",
+            "_active_budgets",
+            "_active_token_sinks",
+            "_tap_state",
+        )
 
         # Paged KV layout (engine/paging.py): prefix-cache entries and the
         # continuous decode loop's slots hold refcounted PAGES of a fixed pool
@@ -670,16 +694,17 @@ class LocalEngine:
         if not self.prefix_cache_size:
             return self._prefill_full(prompt_ids, prompt_len, bucket)
         key = tuple(prompt_ids)
-        hit = self._prefix_entries.get(key)
         # Exact hits must honor the layout label (entry index 4): a REPLICATED
         # entry handed to ring decode gathers the whole prefix into every
         # device's HBM — the exact spike sp_decode exists to avoid. Treat a
         # wrong-layout hit as a miss; the full SP prefill below overwrites the
         # entry with its sequence-sharded twin.
-        if hit is not None and hit[4]:
-            self._prefix_entries.move_to_end(key)
-            self.prefix_cache_stats["hits"] += 1
-            return hit[0], hit[1]
+        with self._paged_mutex:
+            hit = self._prefix_entries.get(key)
+            if hit is not None and hit[4]:
+                self._prefix_entries.move_to_end(key)
+                self.prefix_cache_stats["hits"] += 1
+                return hit[0], hit[1]
 
         matched_kv, p = self._sp_prefix_match(prompt_ids)
         if matched_kv is not None and p >= self.prefix_cache_min_reuse:
@@ -965,14 +990,15 @@ class LocalEngine:
         layout (capping rules live here, once for both routes)."""
         ids_np = np.asarray(ids, np.int32)
         best_kv, best_p = None, 0
-        for _, kv, plen, arr, seq_sharded in self._prefix_entries.values():
-            if seq_sharded != want_seq_sharded:
-                continue
-            limit = min(len(ids) - 1, plen)
-            neq = np.flatnonzero(arr[:limit] != ids_np[:limit])
-            p = int(neq[0]) if neq.size else limit
-            if p > best_p:
-                best_p, best_kv = p, kv
+        with self._paged_mutex:
+            for _, kv, plen, arr, seq_sharded in self._prefix_entries.values():
+                if seq_sharded != want_seq_sharded:
+                    continue
+                limit = min(len(ids) - 1, plen)
+                neq = np.flatnonzero(arr[:limit] != ids_np[:limit])
+                p = int(neq[0]) if neq.size else limit
+                if p > best_p:
+                    best_p, best_kv = p, kv
         return best_kv, best_p
 
     # With attention_impl="xla", continuation prefill materializes a per-layer
@@ -1161,6 +1187,7 @@ class LocalEngine:
     def _reset_tap_state(self) -> None:
         """Per-launch reorder state for the streaming token tap. The scheduler
         serializes device launches, so one tap stream is live at a time."""
+        # kllms: unguarded — one launch in flight; serialized by the scheduler, not a lock
         self._tap_state = {"next": 0, "pending": {}, "seen": set()}
 
     def _deliver_tap_step(self, step: int, toks: np.ndarray) -> None:

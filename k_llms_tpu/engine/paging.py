@@ -305,7 +305,8 @@ class PagedKVPool:
         return self.allocator.total_pages * self.page_size
 
     def pool_bytes(self) -> int:
-        return 2 * int(np.prod(self.kv.k.shape)) * self.kv.k.dtype.itemsize
+        with self.lock:
+            return 2 * int(np.prod(self.kv.k.shape)) * self.kv.k.dtype.itemsize
 
     # -- jitted movers -----------------------------------------------------
 

@@ -49,7 +49,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..analysis.lockcheck import make_condition
+from ..analysis.lockcheck import make_condition, race_exempt
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
 from ..types.wire import BackendUnavailableError, RateLimitError, ServerDrainingError
@@ -210,7 +210,10 @@ class EngineScheduler:
         self._in_flight = 0
         self._state = ServerState.STARTING
         # Adaptive-width backoff: effective row cap is max_rows >> _width_shift.
+        # _effective_max_rows reads it lock-free (see its inline suppression);
+        # the runtime exemption mirrors that decision for the sanitizer.
         self._width_shift = 0
+        race_exempt(self, "_width_shift")
         self._ok_since_backoff = 0
         # (monotonic_time, weight) samples of recently completed work, for the
         # drain-rate estimate behind RateLimitError.retry_after.
@@ -228,6 +231,7 @@ class EngineScheduler:
     def _effective_max_rows(self) -> int:
         """Row cap after OOM backoff (caller holds no lock; reads are atomic
         enough for an admission heuristic)."""
+        # kllms: ignore[guarded-by] — atomic int read; admission heuristic only
         return max(1, self.max_rows >> self._width_shift)
 
     def note_oom(self) -> None:

@@ -52,7 +52,7 @@ from collections import deque
 from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
-from ..analysis.lockcheck import make_lock
+from ..analysis.lockcheck import make_lock, race_exempt
 from ..backends.base import Backend, ChatRequest
 from ..types import ChatCompletion
 from ..types.wire import (
@@ -242,7 +242,11 @@ class ReplicaSet(Backend):
         )
         self._rr_lock = make_lock("reliability.replica_rr")
         self._rr_next = 0
+        # Monotonic shutdown latch: a stale False costs at most one extra
+        # probe submission, which the shut-down executor rejects harmlessly.
+        # kllms: unguarded — monotonic shutdown latch; stale reads are benign
         self._closed = False
+        race_exempt(self, "_closed")
         # Sized for hedged dispatch (2 workers per in-flight hedged request)
         # plus background probes. The wait loop runs on the caller's thread,
         # never in this pool, so saturation queues work instead of deadlocking.
@@ -291,16 +295,21 @@ class ReplicaSet(Backend):
         for handle in self._handles:
             snap = handle.safe_health()
             state = str(snap.get("state", "ready"))
-            if handle.in_rotation and state in _OUT_OF_ROTATION_STATES:
+            with handle.lock:
+                in_rotation = handle.in_rotation
+            if in_rotation and state in _OUT_OF_ROTATION_STATES:
                 handle.mark_down(f"backend state: {state}")
+                in_rotation = False
                 ROUTE_EVENTS.record("route.pulled")
                 logger.warning(
                     "replica %s pulled from rotation (state=%s)",
                     handle.replica_id,
                     state,
                 )
-            if not handle.in_rotation:
-                reasons[handle.replica_id] = handle.out_reason or "out of rotation"
+            if not in_rotation:
+                with handle.lock:
+                    out_reason = handle.out_reason
+                reasons[handle.replica_id] = out_reason or "out of rotation"
                 self._maybe_probe_async(handle)
                 continue
             if handle.replica_id in exclude:
@@ -324,9 +333,12 @@ class ReplicaSet(Backend):
         eligible, reasons = self._eligible(exclude)
         if not eligible:
             for handle in self._handles:
-                if handle.in_rotation or handle.replica_id in exclude:
+                with handle.lock:
+                    in_rotation = handle.in_rotation
+                    last_probe_at = handle.last_probe_at
+                if in_rotation or handle.replica_id in exclude:
                     continue
-                if time.monotonic() - handle.last_probe_at < self.probe_interval_s:
+                if time.monotonic() - last_probe_at < self.probe_interval_s:
                     continue
                 if self._probe(handle):
                     return handle
@@ -378,7 +390,8 @@ class ReplicaSet(Backend):
         greedy, deadline-bounded) generation before it serves traffic again.
         A passing probe also records a breaker success, so a half-open
         circuit closes off the probe rather than off a user request."""
-        handle.last_probe_at = time.monotonic()
+        with handle.lock:
+            handle.last_probe_at = time.monotonic()
         ROUTE_EVENTS.record("route.probes")
         try:
             _failpoints.fire_keyed("replica.probe", handle.replica_id)
@@ -671,7 +684,9 @@ class ReplicaSet(Backend):
         self, texts: List[str], max_tokens: int, model: Optional[str] = None
     ) -> List[str]:
         for handle in self._handles:
-            if handle.in_rotation:
+            with handle.lock:
+                in_rotation = handle.in_rotation
+            if in_rotation:
                 return handle.backend.crop_texts(texts, max_tokens, model=model)
         return self._handles[0].backend.crop_texts(texts, max_tokens, model=model)
 

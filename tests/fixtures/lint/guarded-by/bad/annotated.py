@@ -1,0 +1,23 @@
+"""guarded-by fixture: annotation failures — a declared guard that is not
+held, a guard naming no known lock, and a reasonless unguarded marker."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Annotated:
+    def __init__(self):
+        self._lock = make_lock("fix.annotated")
+        self._items = []  # kllms: guarded-by[fix.annotated]
+        self._ghost = []  # kllms: guarded-by[fix.nosuch]
+        self._bare = 0  # kllms: unguarded
+
+    def add(self, x):
+        self._items.append(x)  # BAD: declared guard not held
+
+    def haunt(self, x):
+        with self._lock:
+            self._ghost.append(x)
+
+    def bump(self):
+        self._bare += 1
+        return self._bare

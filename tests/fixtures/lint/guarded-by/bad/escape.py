@@ -1,0 +1,23 @@
+"""guarded-by fixture: a guarded mutable container escaping its critical
+section — returned raw and handed raw to an executor."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Leaky:
+    def __init__(self, executor):
+        self._lock = make_lock("fix.leaky")
+        self._ring = []
+        self._executor = executor
+
+    def push(self, item):
+        with self._lock:
+            self._ring.append(item)
+
+    def raw(self):
+        with self._lock:
+            return self._ring  # BAD: reference outlives the lock
+
+    def hand_off(self):
+        with self._lock:
+            self._executor.submit(sorted, self._ring)  # BAD: escapes to pool

@@ -1,0 +1,21 @@
+"""guarded-by fixture: a minority access site that skips the inferred
+majority guard."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Stats:
+    def __init__(self):
+        self._lock = make_lock("fix.stats")
+        self._counts = {}
+
+    def bump(self, key):
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def also_bump(self, key):
+        with self._lock:
+            self._counts[key] = 1
+
+    def peek(self, key):
+        return self._counts.get(key)  # BAD: unlocked minority read

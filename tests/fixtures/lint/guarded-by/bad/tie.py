@@ -1,0 +1,19 @@
+"""guarded-by fixture: two locks held at every access site — majority
+inference ties and demands an explicit annotation."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Torn:
+    def __init__(self):
+        self._a = make_lock("fix.torn_a")
+        self._b = make_lock("fix.torn_b")
+        self._val = 0
+
+    def left(self):
+        with self._a, self._b:
+            self._val += 1
+
+    def right(self):
+        with self._a, self._b:
+            self._val -= 1

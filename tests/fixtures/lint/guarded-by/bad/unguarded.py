@@ -1,0 +1,21 @@
+"""guarded-by fixture: an attribute written from two methods with an empty
+inferred lockset — the classic multi-writer race shape."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Gauge:
+    def __init__(self):
+        self._lock = make_lock("fix.gauge")
+        self._guarded = 0
+        self.level = 0
+
+    def up(self):
+        self.level += 1  # BAD: no lock, and down() also writes it
+        with self._lock:
+            self._guarded += 1
+
+    def down(self):
+        self.level -= 1  # BAD: no lock, and up() also writes it
+        with self._lock:
+            self._guarded -= 1

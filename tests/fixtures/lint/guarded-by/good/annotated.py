@@ -1,0 +1,26 @@
+"""guarded-by fixture: explicit annotations — a declared guard that is
+honored, and an unguarded-by-design field with a reason."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = make_lock("fix.recorder")
+        self._aux = make_lock("fix.recorder_aux")
+        self._ring = []  # kllms: guarded-by[fix.recorder]
+        self._hint = 0  # kllms: unguarded — monotonic hint; torn reads benign
+
+    def record(self, item):
+        with self._lock:
+            self._ring.append(item)
+        self._hint += 1
+
+    def hint(self):
+        return self._hint
+
+    def flush(self):
+        with self._lock:
+            out = list(self._ring)
+            self._ring.clear()
+        return out

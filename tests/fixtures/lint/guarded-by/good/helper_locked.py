@@ -1,0 +1,28 @@
+"""guarded-by fixture: helpers reached only from locked regions (the
+interprocedural entry-lockset fixpoint) and the *_locked naming convention
+both count as holding the guard."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Pool:
+    def __init__(self):
+        self._lock = make_lock("fix.pool")
+        self._free = []
+
+    def put(self, page):
+        with self._lock:
+            self._push(page)
+
+    def take(self):
+        with self._lock:
+            return self._pop_locked()
+
+    def _push(self, page):
+        # Private and only ever called with the lock held: the fixpoint
+        # assigns it entry lockset {fix.pool}.
+        self._free.append(page)
+
+    def _pop_locked(self):
+        # The *_locked suffix floors the entry lockset at the class primary.
+        return self._free.pop() if self._free else None

@@ -1,0 +1,24 @@
+"""guarded-by fixture: every access to guarded state holds the inferred
+lock, and snapshots hand out copies instead of the raw container."""
+
+from k_llms_tpu.analysis.lockcheck import make_lock
+
+
+class Journal:
+    def __init__(self):
+        self._lock = make_lock("fix.journal")
+        self._entries = []
+        self._count = 0
+
+    def add(self, item):
+        with self._lock:
+            self._entries.append(item)
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._entries)
+
+    def total(self):
+        with self._lock:
+            return self._count

@@ -35,7 +35,18 @@ EXPECTED_RULES = {
     "failpoint-coverage",
     "counter-hygiene",
     "wire-error-contract",
+    "guarded-by",
+    "guarded-by-unguarded",
+    "guarded-by-escape",
+    "guarded-by-annotation",
 }
+
+GUARDED_BY_FAMILY = (
+    "guarded-by",
+    "guarded-by-unguarded",
+    "guarded-by-escape",
+    "guarded-by-annotation",
+)
 
 
 def run_fixture(rule_id, rel, config=None, readme=None, test_sources=None):
@@ -264,6 +275,68 @@ def test_wire_error_contract_good_fixture_is_clean():
     )
 
 
+def test_guarded_by_good_fixtures_are_clean():
+    for rid in GUARDED_BY_FAMILY:
+        assert messages(run_fixture(rid, "guarded-by/good")) == [], rid
+
+
+def test_guarded_by_bad_fixture_flags_minority_declared_and_tie():
+    msgs = messages(run_fixture("guarded-by", "guarded-by/bad"))
+    assert len(msgs) == 3
+    declared = [m for m in msgs if "declared via # kllms: guarded-by" in m]
+    assert len(declared) == 1
+    assert "Annotated._items" in declared[0] and "Annotated.add" in declared[0]
+    inferred = [m for m in msgs if "inferred: held at 2 of 3 access sites" in m]
+    assert len(inferred) == 1
+    assert "Stats._counts" in inferred[0] and "read in Stats.peek" in inferred[0]
+    tie = [m for m in msgs if "cannot infer a guard" in m]
+    assert len(tie) == 1
+    assert "'fix.torn_a'" in tie[0] and "'fix.torn_b'" in tie[0]
+    assert "guarded-by[<lock>]" in tie[0]
+
+
+def test_guarded_by_unguarded_bad_fixture_names_every_writer():
+    msgs = messages(run_fixture("guarded-by-unguarded", "guarded-by/bad"))
+    assert len(msgs) == 1
+    assert "Gauge.level is written from 2 methods" in msgs[0]
+    assert "Gauge.down, Gauge.up" in msgs[0]
+    assert "kllms: unguarded" in msgs[0]
+
+
+def test_guarded_by_unguarded_min_writers_config_is_load_bearing():
+    cfg = {"guarded-by": {"min_write_methods": 3}}
+    assert messages(run_fixture("guarded-by-unguarded", "guarded-by/bad", cfg)) == []
+
+
+def test_guarded_by_ignore_pattern_exempts_attribute():
+    cfg = {"guarded-by": {"ignore": ["Stats._*"]}}
+    assert (
+        messages(run_fixture("guarded-by", "guarded-by/bad/inferred.py", cfg)) == []
+    )
+
+
+def test_guarded_by_escape_bad_fixture():
+    msgs = messages(run_fixture("guarded-by-escape", "guarded-by/bad"))
+    assert len(msgs) == 2
+    assert sum("returned raw from Leaky.raw" in m for m in msgs) == 1
+    assert (
+        sum("passed raw into self._executor.submit" in m for m in msgs) == 1
+    )
+    assert all("Leaky._ring" in m and "'fix.leaky'" in m for m in msgs)
+
+
+def test_guarded_by_annotation_bad_fixture_cross_checks_lock_names():
+    msgs = messages(run_fixture("guarded-by-annotation", "guarded-by/bad"))
+    assert len(msgs) == 2
+    unknown = [m for m in msgs if "names no known lock" in m]
+    assert len(unknown) == 1
+    # The cross-check vocabulary comes from the lock-order extraction: the
+    # typo'd name is rejected and the class's canonical names are offered.
+    assert "fix.nosuch" in unknown[0]
+    assert "canonical names for Annotated: fix.annotated" in unknown[0]
+    assert sum("needs a reason" in m for m in msgs) == 1
+
+
 # ---------------------------------------------------------------------------
 # suppression machinery + parse errors
 # ---------------------------------------------------------------------------
@@ -365,3 +438,89 @@ def test_package_lint_in_process_matches_cli():
     project = load_project(REPO)
     findings = unsuppressed(run_rules(project))
     assert not findings, "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# SARIF output + baseline suppression
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_output_matches_2_1_0_shape():
+    """Pin the SARIF 2.1.0 shape CI consumes: schema/version headers, the
+    rule metadata as driver rule descriptors, and per-result locations."""
+    proc = _cli(
+        "--root",
+        str(FIXTURES),
+        str(FIXTURES / "guarded-by" / "bad"),
+        "--rule",
+        "guarded-by",
+        "--sarif",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "kllms-check"
+    assert [r["id"] for r in driver["rules"]] == ["guarded-by"]
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"]
+        assert r["fullDescription"]["text"]
+    assert run["originalUriBaseIds"]["SRCROOT"]["uri"].startswith("file://")
+    assert len(run["results"]) == 3
+    for res in run["results"]:
+        assert res["ruleId"] == "guarded-by"
+        assert res["ruleIndex"] == 0
+        assert res["level"] == "error"
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+        assert loc["region"]["startLine"] >= 1
+        assert res["partialFingerprints"]["kllmsFingerprint/v1"]
+
+
+def test_sarif_and_json_are_mutually_exclusive():
+    proc = _cli("--sarif", "--json")
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr
+
+
+def test_baseline_makes_dirty_tree_pass_but_new_finding_fails(tmp_path):
+    bad = str(FIXTURES / "guarded-by" / "bad")
+    base = tmp_path / "baseline.json"
+    proc = _cli(
+        "--root", str(FIXTURES), bad,
+        "--rule", "guarded-by",
+        "--write-baseline", str(base),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(base.read_text(encoding="utf-8"))
+    assert doc["version"] == 1
+    assert len(doc["fingerprints"]) == 3
+    # The dirty tree passes against its recorded baseline...
+    proc = _cli(
+        "--root", str(FIXTURES), bad,
+        "--rule", "guarded-by",
+        "--check", "--baseline", str(base),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # ...while findings NOT in the baseline (here: another family rule over
+    # the same tree) still fail the run.
+    proc = _cli(
+        "--root", str(FIXTURES), bad,
+        "--rule", "guarded-by", "--rule", "guarded-by-escape",
+        "--check", "--baseline", str(base),
+    )
+    assert proc.returncode == 1
+    assert "guarded-by-escape" in proc.stdout
+    assert "declared via # kllms: guarded-by" not in proc.stdout
+
+
+def test_baseline_usage_error_on_malformed_file(tmp_path):
+    broken = tmp_path / "broken.json"
+    broken.write_text("not json", encoding="utf-8")
+    proc = _cli("--baseline", str(broken))
+    assert proc.returncode == 2
+    assert "--baseline" in proc.stderr

@@ -5,8 +5,8 @@ poison logits, and leak KV pages — under concurrent streaming, grammar-
 constrained, and plain n-way traffic on the continuous-batching backend.
 Every request must resolve (success or typed error, never a hung future),
 rebuilds must stay bounded, the page pool must end conserved, the scheduler
-must end READY, and the lock-order graph must come out clean under
-KLLMS_LOCKCHECK=1.
+must end READY, and both the lock-order graph and the Eraser-style lockset
+sanitizer must come out clean under KLLMS_LOCKCHECK=1 + KLLMS_RACECHECK=1.
 """
 
 import threading
@@ -57,6 +57,7 @@ def test_continuous_chaos_soak_mixed_traffic(monkeypatch):
     under mixed stream/grammar/non-stream concurrency, then engine.pages=leak
     — the full fault-domain tour on one live backend."""
     monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    monkeypatch.setenv("KLLMS_RACECHECK", "1")
     lockcheck.reset_state()
     backend = _backend()
     client = KLLMs(backend=backend, model="tiny")

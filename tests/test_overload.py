@@ -625,9 +625,12 @@ def test_overload_soak_4x_capacity_bounded_and_typed(monkeypatch):
     recovered via group split with all survivors completing, and drain()
     returning with the queue empty and the worker joined.
 
-    Runs under KLLMS_LOCKCHECK=1: every lock the backend creates below is
-    instrumented, and the soak must end with a clean lock-order graph."""
+    Runs under KLLMS_LOCKCHECK=1 + KLLMS_RACECHECK=1: every lock the backend
+    creates below is instrumented and every factory-locked object's fields go
+    through the lockset sanitizer; the soak must end with a clean lock-order
+    graph and zero empty-lockset findings."""
     monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    monkeypatch.setenv("KLLMS_RACECHECK", "1")
     lockcheck.reset_state()
     cap = 32
     b = TpuBackend(

@@ -1,0 +1,157 @@
+"""Regression tests (two real threads each) for the races the guarded-by
+rule surfaced and this change fixed.
+
+Each test pins the fix with a deterministic mutual-exclusion oracle instead
+of a probabilistic hammer: the main thread HOLDS the guarding lock while a
+second real thread calls the fixed method. Before the fix the method touched
+the shared state without the lock and completed (or snapshotted stale state)
+immediately; after the fix it must block until the lock is released and then
+observe the mutation made while the lock was held. A scheduling delay can
+only make the pre-fix failure *less* likely to be missed, never fail the
+fixed code.
+"""
+
+import threading
+import time
+
+import pytest
+
+from k_llms_tpu.backends.fake import FakeBackend
+from k_llms_tpu.reliability.replicas import ReplicaSet
+
+# The blocked-reader probe window: long enough for the worker thread to hit
+# the contended section, short enough to keep tier-1 fast.
+_WINDOW_S = 0.15
+
+
+def _start(fn):
+    out = {}
+
+    def run():
+        out["value"] = fn()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, out
+
+
+def _finish(t, out):
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    return out["value"]
+
+
+# ---------------------------------------------------------------------------
+# ContinuousDecodeLoop.stats: the counter snapshot must happen under the
+# loop lock (it used to dict() the stats BEFORE acquiring it).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.duration_budget(30)
+def test_continuous_stats_snapshot_is_taken_under_the_loop_lock():
+    from conftest import shared_engine
+    from k_llms_tpu.engine.continuous import ContinuousDecodeLoop
+
+    eng = shared_engine(model="tiny")
+    loop = ContinuousDecodeLoop(eng, width=2, max_prompt=64, max_new=32)
+    try:
+        with loop._lock:
+            t, out = _start(lambda: loop.stats)
+            time.sleep(_WINDOW_S)
+            # Mutate while still holding the lock: a snapshot taken outside
+            # the lock (the old bug) has already run dict(self._stats) and
+            # cannot see this key.
+            loop._stats["race_probe"] = "set-under-lock"
+        snap = _finish(t, out)
+        assert snap["race_probe"] == "set-under-lock"
+    finally:
+        loop.stop()
+
+
+# ---------------------------------------------------------------------------
+# PagedKVPool.pool_bytes: reads self.kv (atomically swapped under self.lock)
+# and must wait for the pool lock.
+# ---------------------------------------------------------------------------
+
+
+def test_pool_bytes_waits_for_the_pool_lock():
+    from k_llms_tpu.engine.paging import PagedKVPool
+    from k_llms_tpu.models import get_config
+
+    pool = PagedKVPool(get_config("tiny"), total_pages=4, page_size=8)
+    with pool.lock:
+        t, out = _start(pool.pool_bytes)
+        time.sleep(_WINDOW_S)
+        # The old unlocked read has already returned by now.
+        assert not out, "pool_bytes completed while the pool lock was held"
+    size = _finish(t, out)
+    assert size == pool.pool_bytes() > 0
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSet: in_rotation / out_reason / last_probe_at are ReplicaHandle.lock
+# state; _eligible, crop_texts and _probe must synchronize on it.
+# ---------------------------------------------------------------------------
+
+
+def _replica_set():
+    return ReplicaSet(
+        members=[FakeBackend(["ok"])], model="fake", hedge=False
+    )
+
+
+def test_eligible_reads_rotation_state_under_the_handle_lock():
+    rs = _replica_set()
+    handle = rs._handles[0]
+    with handle.lock:
+        t, out = _start(lambda: rs._eligible(frozenset()))
+        time.sleep(_WINDOW_S)
+        assert not out, "_eligible read in_rotation without the handle lock"
+    eligible, reasons = _finish(t, out)
+    assert len(eligible) == 1 and reasons == {}
+
+
+def test_crop_texts_reads_rotation_state_under_the_handle_lock():
+    rs = _replica_set()
+    handle = rs._handles[0]
+    with handle.lock:
+        t, out = _start(lambda: rs.crop_texts(["hello world"], 1))
+        time.sleep(_WINDOW_S)
+        assert not out, "crop_texts read in_rotation without the handle lock"
+    assert _finish(t, out)
+
+
+def test_probe_stamps_last_probe_at_under_the_handle_lock():
+    rs = _replica_set()
+    handle = rs._handles[0]
+    before = handle.last_probe_at
+    with handle.lock:
+        t, out = _start(lambda: rs._probe(handle))
+        time.sleep(_WINDOW_S)
+        # The very first statement of _probe is the stamp: if it ran without
+        # the lock the timestamp has already moved.
+        assert handle.last_probe_at == before, (
+            "_probe wrote last_probe_at without the handle lock"
+        )
+    assert _finish(t, out) is True
+    assert handle.last_probe_at > before
+
+
+# ---------------------------------------------------------------------------
+# LocalEngine prefix cache: the longest-common-prefix scan races the
+# continuous loop's admission/store path unless it runs under _paged_mutex.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.duration_budget(30)
+def test_prefix_match_scan_runs_under_the_paged_mutex():
+    from conftest import shared_engine
+
+    eng = shared_engine(model="tiny")
+    with eng._paged_mutex:
+        t, out = _start(lambda: eng._match_prefix_entries([1, 2, 3], False))
+        time.sleep(_WINDOW_S)
+        assert not out, (
+            "_match_prefix_entries scanned the cache without _paged_mutex"
+        )
+    assert _finish(t, out) == (None, 0)

@@ -614,9 +614,12 @@ def test_chaos_soak_flapping_member_under_concurrent_traffic(monkeypatch):
     resolves with a typed result or typed error, zero hung futures, failovers
     stay bounded, and the flapping member rejoins after a probe passes.
 
-    Runs under KLLMS_LOCKCHECK=1: router + per-replica + breaker locks are
-    instrumented, and the soak must end with a clean lock-order graph."""
+    Runs under KLLMS_LOCKCHECK=1 + KLLMS_RACECHECK=1: router + per-replica +
+    breaker locks are instrumented and handle/router fields go through the
+    lockset sanitizer; the soak must end with a clean lock-order graph and
+    zero empty-lockset findings."""
     monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    monkeypatch.setenv("KLLMS_RACECHECK", "1")
     lockcheck.reset_state()
     members = [FakeBackend(["m0"]), FakeBackend(["m1"]), FakeBackend(["m2"])]
     rs = ReplicaSet(

@@ -457,10 +457,11 @@ def test_chaos_soak_hang_and_nan_mid_traffic(monkeypatch):
     typed error), zero hung futures, rebuilds stay bounded, and the engine
     returns to READY for clean traffic afterwards.
 
-    Runs under KLLMS_LOCKCHECK=1: rebuild/replay churn exercises the
-    supervisor, scheduler, and engine locks together; the soak must end with
-    a clean lock-order graph."""
+    Runs under KLLMS_LOCKCHECK=1 + KLLMS_RACECHECK=1: rebuild/replay churn
+    exercises the supervisor, scheduler, and engine locks together; the soak
+    must end with a clean lock-order graph and zero empty-lockset findings."""
     monkeypatch.setenv("KLLMS_LOCKCHECK", "1")
+    monkeypatch.setenv("KLLMS_RACECHECK", "1")
     lockcheck.reset_state()
     # Budget 8 s: far below the 30 s hang (the watchdog MUST fire) but roomy
     # enough that a post-rebuild replay — full recompile + a 32-row coalesced
