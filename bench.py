@@ -1168,6 +1168,83 @@ def bench_hedging() -> dict:
     }
 
 
+def bench_tenancy() -> dict:
+    """Weighted-fair isolation under skewed offered load (ISSUE 16,
+    hermetic — EngineScheduler directly, no device): two equal-weight
+    tenants, one offering 10x the other's load, every item pre-queued
+    behind a blocked worker so the dequeue ORDER is pure scheduler policy.
+    The acceptance number: at the instant the light tenant's last item is
+    served, the heavy tenant must have been served a near-equal share —
+    equal weights mean equal goodput, regardless of the 10:1 backlog skew.
+    A FIFO queue would score ~10:1 here (the light tenant starves behind
+    the flood); WFQ alternates and scores ~1:1."""
+    import threading
+
+    from k_llms_tpu.engine.scheduler import EngineScheduler
+    from k_llms_tpu.reliability.tenancy import TenancyConfig
+
+    heavy_n, light_n = 1000, 100
+    tenancy = TenancyConfig.from_options(
+        tenants={"heavy": {"weight": 1.0}, "light": {"weight": 1.0}}
+    )
+    sched = EngineScheduler(
+        name="bench-tenancy", batch_window=0.0, tenancy=tenancy
+    )
+    served = {"heavy": 0, "light": 0}
+    heavy_at_light_done = [0]
+    gate = threading.Event()
+    blocker = sched.submit(gate.wait)
+    while not (sched.stats["queued"] == 0 and blocker.running()):
+        time.sleep(0.005)
+
+    def make_fn(tenant: str, last_light: bool):
+        def fn(payloads):
+            served[tenant] += len(payloads)
+            if last_light:
+                heavy_at_light_done[0] = served["heavy"]
+            return list(payloads)
+
+        return fn
+
+    futures = []
+    # Heavy floods FIRST: with FIFO dequeue the light tenant would wait out
+    # the full 10x backlog before its first item moves.
+    for i in range(heavy_n):
+        futures.append(sched.submit_batched(
+            ("heavy", i), i, make_fn("heavy", False), weight=1, tenant="heavy"
+        ))
+    for i in range(light_n):
+        futures.append(sched.submit_batched(
+            ("light", i), i, make_fn("light", i == light_n - 1),
+            weight=1, tenant="light",
+        ))
+    t0 = time.perf_counter()
+    gate.set()
+    for f in futures:
+        f.result(timeout=120)
+    drain_s = time.perf_counter() - t0
+    blocker.result(timeout=10)
+    health = sched.health()
+    sched.shutdown()
+
+    # Goodput split while BOTH tenants were backlogged: served counts at the
+    # moment the light tenant finished. Equal weights -> ratio ~1.0.
+    heavy_share = heavy_at_light_done[0]
+    ratio = heavy_share / max(1, light_n)
+    return {
+        "offered": {"heavy": heavy_n, "light": light_n},
+        "weights": {"heavy": 1.0, "light": 1.0},
+        "heavy_served_at_light_done": heavy_share,
+        "light_served": light_n,
+        "goodput_ratio_heavy_over_light": round(ratio, 3),
+        "within_10pct_of_weights": bool(abs(ratio - 1.0) <= 0.10),
+        "drain_s": round(drain_s, 3),
+        "served_per_tenant": {
+            t: health["tenants"][t]["served"] for t in ("heavy", "light")
+        },
+    }
+
+
 def _emit(value, vs_baseline, detail: dict, error: "str | None" = None) -> None:
     line = {
         "metric": "n32_consensus_p50_over_single_p50",
@@ -1211,6 +1288,10 @@ def main() -> None:
         detail["hedging"] = bench_hedging()
     except Exception as exc:  # hermetic like quality; a failure here is a bug
         detail["hedging"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        detail["tenancy"] = bench_tenancy()
+    except Exception as exc:  # hermetic like quality; a failure here is a bug
+        detail["tenancy"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     try:
         detail["serving"] = bench_serving()
     except Exception as exc:  # hermetic like quality; a failure here is a bug

@@ -43,6 +43,11 @@ class ChatRequest:
     # cooperative cancel token; threaded into scheduler admission and the
     # engine decode loop. None = unbounded (the reference's no-timeout default).
     budget: Optional[RequestBudget] = None
+    # Tenant id this request bills against (resolved from the API key at the
+    # serving front door, or passed explicitly in-process). None = the
+    # permissive "default" tenant. A plain string: the scheduler resolves it
+    # to a TenantContext at admission so quota state lives in one place.
+    tenant: Optional[str] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -218,6 +218,24 @@ class BackendConfig(BaseModel):
     # validation in parse() stays authoritative either way (counted, see
     # GRAMMAR_EVENTS). False = the pre-PR-12 post-hoc-only posture.
     constrained_decoding: bool = True
+    # -- multi-tenant isolation (PR 16) ------------------------------------
+    # Per-tenant token-bucket quotas, WFQ dequeue weights, and SLO classes
+    # (reliability/tenancy.py). Defaults apply to every tenant not listed in
+    # ``tenants``; None rates = unlimited (the pre-PR-16 posture). ``tenants``
+    # maps tenant name -> TenantSpec field overrides ({"weight": 3.0,
+    # "slo": "batch", "requests_per_s": 5, ...}); ``tenant_api_keys`` maps
+    # API key -> tenant name for the serving front door (unmapped keys become
+    # their own dynamic tenant under the default spec).
+    tenant_default_weight: float = 1.0
+    tenant_default_slo: str = "interactive"
+    tenant_default_requests_per_s: Optional[float] = None
+    tenant_default_rows_per_s: Optional[float] = None
+    tenants: Optional[Dict[str, Dict[str, Any]]] = None
+    tenant_api_keys: Optional[Dict[str, str]] = None
+    # Brownout trigger: queued-weight fraction of max_queue_weight at which
+    # the scheduler starts shedding batch-class admissions (also armed by
+    # sustained OOM backoff, width_shift >= 2). See engine/scheduler.py.
+    brownout_high_water: float = 0.9
 
 
 def _detect_hbm_bytes() -> Optional[int]:
@@ -478,10 +496,25 @@ class TpuBackend(Backend):
         scheduler_kwargs: Dict[str, Any] = {}
         if cfg.max_batch_rows is not None:
             scheduler_kwargs["max_rows"] = cfg.max_batch_rows
+        # Multi-tenant quota/fairness registry: one per backend, shared by the
+        # coalescing scheduler, the continuous loop, and the serving front
+        # door's API-key resolution (backend.tenancy).
+        from ..reliability.tenancy import TenancyConfig
+
+        self.tenancy = TenancyConfig.from_options(
+            default_weight=cfg.tenant_default_weight,
+            default_slo=cfg.tenant_default_slo,
+            default_requests_per_s=cfg.tenant_default_requests_per_s,
+            default_rows_per_s=cfg.tenant_default_rows_per_s,
+            tenants=cfg.tenants,
+            api_keys=cfg.tenant_api_keys,
+        )
         self.scheduler = EngineScheduler(
             name=self.model_name,
             batch_window=cfg.batch_window,
             max_queue_weight=cfg.max_queue_weight,
+            tenancy=self.tenancy,
+            brownout_high_water=cfg.brownout_high_water,
             **scheduler_kwargs,
         )
         # Consensus cache/dispatch stats ride along scheduler.stats()/health().
@@ -751,6 +784,7 @@ class TpuBackend(Backend):
             stop_sequences=stop_seqs,
             budget=request.budget,
             token_sink=detok.feed if detok is not None else None,
+            tenant=request.tenant,
         )
 
         choices: List[Dict[str, Any]] = []
@@ -893,6 +927,7 @@ class TpuBackend(Backend):
         stop_sequences: Optional[List[List[int]]] = None,
         budget=None,
         token_sink=None,
+        tenant=None,
     ):
         """Submit one generation through the coalescing scheduler: concurrent
         requests with the same sampling config decode as ONE batched XLA
@@ -900,7 +935,9 @@ class TpuBackend(Backend):
         ``budget`` rides both the scheduler item (admission control, window
         bounding, queue shedding) and the GenRequestSpec (decode-loop
         cancellation); it is NOT part of the batch_key — different deadlines
-        still coalesce."""
+        still coalesce. ``tenant`` (a name or None) bills this request's
+        padded rows against that tenant's token buckets and keys WFQ dequeue;
+        over-quota requests 429 here before touching either decode path."""
         from ..engine.engine import GenRequestSpec
 
         ckey = None
@@ -929,6 +966,17 @@ class TpuBackend(Backend):
         # uninterrupted run (same weights after reload + same key derivation).
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
+
+        # Weight = this request's padded row count (the engine rounds n up to
+        # a data-parallel multiple), so quota billing and the scheduler's
+        # max_rows bound both track the batch the device will actually see.
+        dp = self.engine.data_parallel_size
+        rows = ((max(1, n) + dp - 1) // dp) * dp
+        # Tenant quota: charged ONCE, up front, before path routing — a
+        # continuous-loop bounds rejection that falls back to coalescing must
+        # not bill the same request twice. Raises the typed 429 (retry_after =
+        # this tenant's own bucket refill) on an empty bucket.
+        tenant_ctx = self.scheduler.charge_tenant_quota(tenant, rows=rows)
 
         # Continuous in-flight batching: qualifying requests join the
         # persistent slot loop the step after admission instead of waiting
@@ -965,6 +1013,7 @@ class TpuBackend(Backend):
                     budget=budget,
                     token_sink=token_sink,
                     grammar=loop_grammar,
+                    tenant=tenant_ctx,
                 ).result()
             except ValueError:
                 # Templated prompt outgrew the loop's bounds, or the loop is
@@ -1002,13 +1051,9 @@ class TpuBackend(Backend):
             LATENCY.observe("engine.decode_launch", time.perf_counter() - t0)
             return out
 
-        # Weight = this request's padded row count (the engine rounds n up to a
-        # data-parallel multiple), so the scheduler's max_rows bound tracks the
-        # batch the device will actually see. max_rows = the HBM memory
-        # model's row cap for THIS request's KV length — any group this item
-        # joins is clipped to the tightest member hint.
-        dp = self.engine.data_parallel_size
-        rows = ((max(1, n) + dp - 1) // dp) * dp
+        # max_rows = the HBM memory model's row cap for THIS request's KV
+        # length — any group this item joins is clipped to the tightest
+        # member hint.
         if (
             getattr(self.engine, "kv_layout", "dense") == "paged"
             and getattr(self.engine, "paged_generate_many", False)
@@ -1032,6 +1077,7 @@ class TpuBackend(Backend):
             weight=rows,
             budget=budget,
             max_rows=max_rows,
+            tenant=tenant_ctx,
         )
         if loop_grammar is not None:
             # Every generated token on this path sampled under the fused

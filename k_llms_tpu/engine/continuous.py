@@ -146,6 +146,21 @@ class _SlotRequest:
     # queue-wait span/histogram. Both are host-side observability only.
     trace: Optional[Any] = None
     enqueued_at: float = 0.0
+    # Resolved TenantContext (or None for the implicit default tenant):
+    # drives WFQ slot selection and per-tenant queue-wait attribution.
+    tenant: Optional[Any] = None
+
+
+def _req_tenant_name(req: "_SlotRequest") -> str:
+    return req.tenant.name if req.tenant is not None else "default"
+
+
+def _req_interactive(req: "_SlotRequest") -> bool:
+    return req.tenant is None or req.tenant.interactive
+
+
+def _req_tenant_weight(req: "_SlotRequest") -> float:
+    return max(req.tenant.weight, 1e-9) if req.tenant is not None else 1.0
 
 
 class _StepHung(RuntimeError):
@@ -323,6 +338,13 @@ class ContinuousDecodeLoop:
         # state must mutate atomically with the arrays it indexes.
         self._lock = make_condition("engine.continuous", allow_dispatch=True)
         self._queue: "deque[_SlotRequest]" = deque()
+        # WFQ slot admission (ISSUE 16): loop-local per-tenant virtual time
+        # and its floor, guarded by the loop lock. The queue stays a single
+        # deque (journal replay depends on appendleft/extendleft positions);
+        # fairness comes from *selection* — _admit_locked picks the earliest
+        # request of the tenant with the smallest (slo_class, vtime) key.
+        self._vtimes: Dict[str, float] = {}
+        self._vfloor = 0.0
         self._active: List[Optional[_SlotRequest]] = [None] * self.width
         self._free: List[int] = list(range(self.width))
         self._closing = False
@@ -495,9 +517,16 @@ class ContinuousDecodeLoop:
         budget: Optional[RequestBudget] = None,
         token_sink: Optional[Callable[[int, np.ndarray], None]] = None,
         grammar: Optional[Any] = None,
+        tenant: Optional[Any] = None,
     ) -> Future:
         """Queue one request for slot admission; returns a Future resolving to
         a :class:`GenerationResult` (or raising the typed lifecycle error).
+
+        ``tenant`` is an already-resolved
+        :class:`~k_llms_tpu.reliability.tenancy.TenantContext` (quota charge
+        happens upstream in the backend): slot admission draws across queued
+        tenants by weighted virtual time, with ``batch``-class work filling
+        slots only when no ``interactive`` work is queued.
 
         ``grammar`` is an optional :class:`CompiledGrammar`: the request's
         rows then decode under the fused schema mask. The loop keeps one
@@ -557,6 +586,7 @@ class ContinuousDecodeLoop:
                 grammar=grammar,
                 trace=current_trace(),
                 enqueued_at=time.monotonic(),
+                tenant=tenant,
             )
             self._seq += 1
             self._queue.append(req)
@@ -1093,13 +1123,42 @@ class ContinuousDecodeLoop:
                 kept.append(req)
         self._queue = kept
 
+    def _select_locked(self) -> Optional[int]:
+        """WFQ selection over the queued requests: index of the EARLIEST
+        request of the tenant with the smallest (slo_class, vtime) key —
+        interactive strictly before batch, then weighted virtual time, then
+        arrival order. Head-of-line within a tenant is preserved: only each
+        tenant's first queued request is a candidate. None on empty queue."""
+        best_idx: Optional[int] = None
+        best_key = None
+        seen: set = set()
+        for idx, req in enumerate(self._queue):
+            name = _req_tenant_name(req)
+            if name in seen:
+                continue
+            seen.add(name)
+            key = (
+                0 if _req_interactive(req) else 1,
+                self._vtimes.get(name, 0.0),
+                idx,
+            )
+            if best_key is None or key < best_key:
+                best_idx, best_key = idx, key
+        return best_idx
+
     def _admit_locked(self) -> None:
-        """FIFO head-of-line admission: the head request joins when all n of
-        its slots are free (no skipping — later small requests must not starve
-        a large head). Called with the lock held; does device writes for the
+        """WFQ head-of-line admission: the selected tenant's earliest request
+        joins when all n of its slots are free (no skipping past it — later
+        small requests must not starve a large one; no cross-tenant skipping
+        either, so a big interactive head blocks batch fill rather than being
+        starved by it). Called with the lock held; does device writes for the
         admitted request's prefill."""
-        while self._queue and len(self._free) >= self._queue[0].n:
-            req = self._queue.popleft()
+        while self._queue:
+            idx = self._select_locked()
+            if idx is None or len(self._free) < self._queue[idx].n:
+                break
+            req = self._queue[idx]
+            del self._queue[idx]
             if req.budget is not None and req.budget.should_abort():
                 FAILURE_EVENTS.record("scheduler.shed")
                 req.future.set_exception(req.budget.error("continuous queue"))
@@ -1107,6 +1166,10 @@ class ContinuousDecodeLoop:
             if req.enqueued_at and not req.replays:
                 wait_s = max(0.0, time.monotonic() - req.enqueued_at)
                 LATENCY.observe("scheduler.queue_wait", wait_s)
+                if req.tenant is not None:
+                    LATENCY.observe(
+                        f"scheduler.queue_wait.{_req_tenant_name(req)}", wait_s
+                    )
                 if req.trace is not None:
                     req.trace.add_phase("queue_wait", wait_s)
             if not self._built:
@@ -1157,6 +1220,14 @@ class ContinuousDecodeLoop:
                 self._stats["admitted"] += 1
                 if in_flight:
                     self._stats["joined_in_flight"] += 1
+                # WFQ pass charge: advance the tenant's virtual time by
+                # rows/weight from the floor (an idle tenant re-enters at the
+                # current floor, not at zero — it must not get unbounded
+                # catch-up credit). Replays were charged at first admission.
+                name = _req_tenant_name(req)
+                start = max(self._vtimes.get(name, 0.0), self._vfloor)
+                self._vfloor = start
+                self._vtimes[name] = start + req.n / _req_tenant_weight(req)
 
     def _admit_device(self, req, rows) -> None:
         engine = self.engine

@@ -34,6 +34,26 @@ lifecycle: a :class:`ServerState`, a ``health()`` snapshot, and
 groups, and joins the worker. Device OOM feedback arrives via ``note_oom()``
 (halves the effective coalescing width) / ``note_recovered()`` (restores it).
 
+Multi-tenancy (ISSUE 16): the single FIFO is now a set of per-tenant FIFO
+queues drained by weighted-fair queuing — each tenant carries a virtual-time
+pass that advances by ``group_weight / tenant_weight`` when its group
+launches, and the worker always serves the backlogged tenant with the
+smallest ``(slo_class, vpass)`` key, so ``interactive`` work strictly
+precedes ``batch`` and equal-weight tenants split device rows evenly no
+matter how unequal their offered load. Coalescing never crosses a tenant
+boundary. Quotas are charged via :meth:`EngineScheduler.charge_tenant_quota`
+(per-tenant token buckets: requests/s and device-row weight/s) whose typed
+429 carries the *tenant's own* bucket-refill ``retry_after``; the
+``scheduler.tenant`` failpoint (keyed by tenant name, ``exhaust`` action)
+forces a miss for drills. Under brownout — queue weight at its high-water
+mark or repeated OOM backoff — ``batch``-class admissions are shed first,
+and capacity eviction prefers batch-class, then over-quota, then
+strictly-lower-priority victims, so in-SLO interactive work is touched last.
+Everything is attributed per tenant (``TENANT_EVENTS``,
+``scheduler.queue_wait.<tenant>`` histograms, per-tenant health section).
+The default (tenancy-less) configuration resolves every request to one
+unlimited interactive tenant, preserving pre-tenancy behavior exactly.
+
 Callers get ``concurrent.futures.Future``s; ``AsyncKLLMs`` awaits them without
 blocking the event loop. Queue depth and service counts are exposed for
 observability.
@@ -52,11 +72,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..analysis.lockcheck import make_condition, race_exempt
 from ..reliability import failpoints as _failpoints
 from ..reliability.deadline import RequestBudget
+from ..reliability.tenancy import TenancyConfig, TenantContext
 from ..types.wire import BackendUnavailableError, RateLimitError, ServerDrainingError
 from ..utils.observability import (
     FAILURE_EVENTS,
     LATENCY,
     SPEC_EVENTS,
+    TENANT_EVENTS,
     current_trace,
 )
 
@@ -103,6 +125,7 @@ class _Item:
         "budget",
         "priority",
         "max_rows",
+        "tenant",
         "trace",
         "trace_phase",
         "enqueued_at",
@@ -120,6 +143,7 @@ class _Item:
         budget=None,
         priority=0,
         max_rows=None,
+        tenant=None,
         trace_phase=None,
     ):
         self.future = future
@@ -132,6 +156,8 @@ class _Item:
         self.budget = budget
         self.priority = priority
         self.max_rows = max_rows
+        # Resolved to a TenantContext by _admit (None until then).
+        self.tenant = tenant
         # Captured on the submitting thread: the worker is a plain Thread and
         # does not inherit contextvars, so the request trace must ride the
         # item. ``trace_phase`` names the span the group's runner duration is
@@ -142,10 +168,28 @@ class _Item:
         self.enqueued_at = time.monotonic()
 
 
+class _TenantQueue:
+    """One tenant's FIFO plus its WFQ virtual-time pass (guarded by the
+    scheduler's condition variable, like the rest of the queue state)."""
+
+    __slots__ = ("ctx", "items", "vpass")
+
+    def __init__(self, ctx: TenantContext):
+        self.ctx = ctx
+        self.items: "deque[_Item]" = deque()
+        self.vpass = 0.0
+
+
 # Rolling window (seconds) over which the drain rate backing ``retry_after``
 # estimates is measured. Long enough to smooth over one multi-second decode,
 # short enough to track a load shift.
 _DRAIN_WINDOW_S = 30.0
+
+# Brownout triggers: queued weight at this fraction of ``max_queue_weight``,
+# or the OOM width backoff at/past this many halvings. Either signals
+# sustained overload, and batch-class admission sheds until it clears.
+_BROWNOUT_HIGH_WATER = 0.9
+_BROWNOUT_WIDTH_SHIFT = 2
 
 
 class EngineScheduler:
@@ -171,8 +215,24 @@ class EngineScheduler:
         max_rows: int = 64,
         batch_window: float = 0.005,
         max_queue_weight: Optional[int] = None,
+        tenancy: Optional[TenancyConfig] = None,
+        brownout_high_water: float = _BROWNOUT_HIGH_WATER,
     ):
-        self._items: "deque[Optional[_Item]]" = deque()
+        # Per-tenant FIFO queues drained by WFQ; insertion-ordered so
+        # selection ties break toward the longest-known tenant.
+        self._queues: Dict[str, _TenantQueue] = {}
+        # WFQ floor: the start-pass of the most recently launched group.
+        # Charging new groups from max(tenant pass, floor) stops an idle
+        # tenant from banking unbounded credit while others were served.
+        self._vfloor = 0.0
+        # shutdown()/drain() signal; replaces the old in-deque None sentinel
+        # (a single FIFO position is meaningless across per-tenant queues).
+        # Same contract: the backlog present at the signal is served first.
+        self._sentinel = False
+        self._tenancy = tenancy if tenancy is not None else TenancyConfig()
+        self._brownout_high_water = brownout_high_water
+        # Per-tenant shed/served attribution for health() (guarded by _cv).
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
         self._cv = make_condition("engine.scheduler")
         self._served = 0
         self._errors = 0
@@ -180,6 +240,8 @@ class EngineScheduler:
         self._coalesced = 0
         self._shed = 0
         self._shed_over_capacity = 0
+        self._shed_brownout = 0
+        self._shed_quota = 0
         self._evicted = 0
         self._oom_splits = 0
         # Speculative-decoding aggregates (engine.on_spec_stats): per-launch
@@ -226,6 +288,73 @@ class EngineScheduler:
             target=self._run, name=f"kllms-{name}-worker", daemon=True
         )
         self._worker.start()
+
+    # -- tenant queue bookkeeping (caller holds self._cv) ------------------
+    def _queue_for_locked(self, ctx: TenantContext) -> _TenantQueue:
+        q = self._queues.get(ctx.name)
+        if q is None:
+            q = self._queues[ctx.name] = _TenantQueue(ctx)
+        return q
+
+    def _backlog_locked(self) -> int:
+        return sum(len(q.items) for q in self._queues.values())
+
+    def _all_items_locked(self) -> List[_Item]:
+        out: List[_Item] = []
+        for q in self._queues.values():
+            out.extend(q.items)
+        return out
+
+    def _clear_queues_locked(self) -> List[_Item]:
+        leftovers = self._all_items_locked()
+        for q in self._queues.values():
+            q.items.clear()
+        self._queue_weight = 0
+        return leftovers
+
+    def _select_queue_locked(self) -> Optional[_TenantQueue]:
+        """The backlogged tenant queue with the smallest (slo_class, vpass)
+        key — interactive strictly before batch, then weighted virtual time.
+        None when nothing is queued."""
+        best: Optional[_TenantQueue] = None
+        best_key: Optional[Tuple[int, float]] = None
+        for q in self._queues.values():
+            if not q.items:
+                continue
+            key = (0 if q.ctx.interactive else 1, q.vpass)
+            if best_key is None or key < best_key:
+                best, best_key = q, key
+        return best
+
+    def _charge_pass_locked(self, q: _TenantQueue, group_weight: int) -> None:
+        """Advance the tenant's virtual time by the launched group's weight
+        over its configured share. The floor keeps a tenant that just went
+        idle from re-entering arbitrarily far in the past."""
+        start = max(q.vpass, self._vfloor)
+        self._vfloor = start
+        q.vpass = start + group_weight / max(q.ctx.weight, 1e-9)
+
+    def _tenant_count_locked(self, ctx: Optional[TenantContext], key: str, n: int = 1) -> None:
+        if ctx is None:
+            return
+        stats = self._tenant_stats.setdefault(ctx.name, {})
+        stats[key] = stats.get(key, 0) + n
+
+    def _brownout_locked(self) -> bool:
+        """Sustained-overload signal: queued weight at the high-water mark of
+        the cap, or the OOM width backoff deep enough that the device is
+        repeatedly refusing full-width launches."""
+        if self._width_shift >= _BROWNOUT_WIDTH_SHIFT:
+            return True
+        return (
+            self.max_queue_weight is not None
+            and self._queue_weight
+            >= self._brownout_high_water * self.max_queue_weight
+        )
+
+    @property
+    def tenancy(self) -> TenancyConfig:
+        return self._tenancy
 
     # -- adaptive width ----------------------------------------------------
     def _effective_max_rows(self) -> int:
@@ -317,9 +446,7 @@ class EngineScheduler:
         its own once it observes STOPPED with an empty queue."""
         with self._cv:
             self._state = ServerState.STOPPED
-            leftovers = [it for it in self._items if it is not None]
-            self._items.clear()
-            self._queue_weight = 0
+            leftovers = self._clear_queues_locked()
             self._shed += len(leftovers)
             self._cv.notify_all()
         # Futures complete outside the lock (callbacks may re-enter).
@@ -365,22 +492,30 @@ class EngineScheduler:
     # -- worker -----------------------------------------------------------
     def _next_group(self) -> Optional[List[_Item]]:
         """Blocks for the next unit of work: a single closure item, or the
-        contiguous head run of batched items sharing one batch_key — held open
-        for up to ``batch_window`` seconds while the queue has no blocking
-        (different-key / over-budget / shutdown) item at its head."""
+        contiguous head run of batched items sharing one batch_key *within
+        the WFQ-selected tenant's queue* — held open for up to
+        ``batch_window`` seconds while that queue has no blocking
+        (different-key / over-budget / shutdown) item at its head. Coalescing
+        never reaches into another tenant's queue: cross-tenant fusion would
+        let a flooding tenant ride a well-behaved tenant's launches."""
         with self._cv:
-            while not self._items:
-                if self._state in (ServerState.DRAINING, ServerState.STOPPED):
-                    # Draining/stopped with an empty queue: nothing more can
-                    # be admitted, so the worker retires without a sentinel.
+            while True:
+                q = self._select_queue_locked()
+                if q is not None:
+                    break
+                if self._sentinel or self._state in (
+                    ServerState.DRAINING,
+                    ServerState.STOPPED,
+                ):
+                    # Shutdown signal or draining/stopped with an empty
+                    # backlog: nothing more can arrive, the worker retires.
                     return None
                 self._cv.wait()
-            head = self._items.popleft()
-            if head is None:
-                return None
+            head = q.items.popleft()
             self._queue_weight -= head.weight
             if head.batch_key is None:
                 self._in_flight += 1
+                self._charge_pass_locked(q, head.weight)
                 return [head]
             group = [head]
             max_w = head.weight
@@ -400,13 +535,12 @@ class EngineScheduler:
                 window = min(window, max(0.0, head.budget.remaining()))
             deadline = time.monotonic() + window
             while len(group) < self.max_batch:
-                if self._items:
-                    nxt = self._items[0]
-                    if nxt is not None and nxt.max_rows is not None:
+                if q.items:
+                    nxt = q.items[0]
+                    if nxt.max_rows is not None:
                         cap = max(1, min(cap, nxt.max_rows))
                     if (
-                        nxt is None
-                        or nxt.batch_key != head.batch_key
+                        nxt.batch_key != head.batch_key
                         # Conservative projected cost: the decode pads the
                         # request count to a power of two (generate_many's
                         # compile bucketing), so admit against
@@ -415,7 +549,7 @@ class EngineScheduler:
                         or _next_pow2(len(group) + 1) * max(max_w, nxt.weight) > cap
                     ):
                         break  # FIFO fairness: never reach around the head
-                    self._items.popleft()
+                    q.items.popleft()
                     self._queue_weight -= nxt.weight
                     max_w = max(max_w, nxt.weight)
                     group.append(nxt)
@@ -424,13 +558,14 @@ class EngineScheduler:
                     continue
                 if _next_pow2(len(group) + 1) * max_w > cap:
                     break  # even a weight-1 arrival couldn't be admitted
-                if self._state is ServerState.DRAINING:
+                if self._sentinel or self._state is ServerState.DRAINING:
                     break  # nothing new can arrive; launch what we have
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._cv.wait(remaining)
             self._in_flight += 1
+            self._charge_pass_locked(q, sum(it.weight for it in group))
             return group
 
     def _shed_spent(self, items: List[_Item]) -> List[_Item]:
@@ -461,12 +596,19 @@ class EngineScheduler:
         while self._drained and self._drained[0][0] < horizon:
             self._drained.popleft()
 
-    def _group_done(self, group: List[_Item], served: int, errors: int) -> None:
+    def _group_done(
+        self, group: List[_Item], served: int, errors: int, drained_weight: int
+    ) -> None:
+        """``drained_weight`` is the weight that actually reached the runner:
+        work shed at dequeue must NOT feed the drain-rate window, or
+        ``retry_after`` under-reports exactly when brownout is shedding the
+        most (a shed is instantaneous, not evidence of service capacity)."""
         with self._cv:
             self._in_flight -= 1
             self._served += served
             self._errors += errors
-            self._record_drained(sum(it.weight for it in group))
+            if drained_weight:
+                self._record_drained(drained_weight)
             if served and group[0].batch_key is not None:
                 self._batches += 1
                 self._coalesced += served - 1
@@ -484,8 +626,11 @@ class EngineScheduler:
                 return
             live = [it for it in group if it.future.set_running_or_notify_cancel()]
             live = self._shed_spent(live)
+            # Only weight that reaches the runner counts toward the drain
+            # rate; shed/cancelled weight vanished without consuming service.
+            live_weight = sum(it.weight for it in live)
             if not live:
-                self._group_done(group, served=0, errors=0)
+                self._group_done(group, served=0, errors=0, drained_weight=0)
                 continue
             # Admission-to-dequeue wait, observed here (outside self._cv —
             # trace/histogram locks are leaves, never nested under the CV).
@@ -493,6 +638,10 @@ class EngineScheduler:
             for it in live:
                 wait_s = max(0.0, now - it.enqueued_at)
                 LATENCY.observe("scheduler.queue_wait", wait_s)
+                if it.tenant is not None:
+                    LATENCY.observe(
+                        f"scheduler.queue_wait.{it.tenant.name}", wait_s
+                    )
                 if it.trace is not None:
                     it.trace.add_phase("queue_wait", wait_s)
             try:
@@ -523,14 +672,34 @@ class EngineScheduler:
                             it.future.set_exception(res)
                         else:
                             it.future.set_result(res)
-                    self._group_done(group, served=len(live), errors=n_failed)
+                    self._note_served(live)
+                    self._group_done(
+                        group,
+                        served=len(live),
+                        errors=n_failed,
+                        drained_weight=live_weight,
+                    )
                     continue
-                self._group_done(group, served=len(live), errors=0)
+                self._note_served(live)
+                self._group_done(
+                    group, served=len(live), errors=0, drained_weight=live_weight
+                )
             except BaseException as e:  # deliver to the caller(s), keep serving
                 for it in live:
                     if not it.future.done():
                         it.future.set_exception(e)
-                self._group_done(group, served=0, errors=len(live))
+                self._group_done(
+                    group, served=0, errors=len(live), drained_weight=live_weight
+                )
+
+    def _note_served(self, live: List[_Item]) -> None:
+        """Per-tenant service attribution (TENANT_EVENTS + health section)."""
+        with self._cv:
+            for it in live:
+                self._tenant_count_locked(it.tenant, "served")
+        for it in live:
+            if it.tenant is not None:
+                TENANT_EVENTS.record(f"tenant.served.{it.tenant.name}")
 
     # -- admission --------------------------------------------------------
     def _drain_rate(self) -> float:
@@ -545,37 +714,73 @@ class EngineScheduler:
 
     def _retry_after(self, weight: int) -> float:
         """Seconds until queued weight should have drained enough to admit
-        ``weight`` more (caller holds self._cv). Clamped to [0.1, 60]."""
+        ``weight`` more (caller holds self._cv). Clamped to [0.1, 60]. This
+        is the *global* capacity estimate (drain window excludes shed work);
+        quota rejections use the tenant's own bucket refill time instead —
+        see :meth:`charge_tenant_quota`."""
         rate = self._drain_rate()
         backlog = self._queue_weight + weight
         est = backlog / rate if rate > 0 else 1.0
         return min(60.0, max(0.1, est))
 
-    def _try_evict_for(self, weight: int, priority: int) -> List[_Item]:
+    def _try_evict_for(
+        self, weight: int, priority: int, tenant: Optional[TenantContext] = None
+    ) -> List[_Item]:
         """Caller holds self._cv. Frees capacity for an incoming item by
-        evicting strictly-lower-priority queued items (higher ``priority``
-        int = less important), scanning from the back of the queue (newest,
-        least sunk wait first). Returns the evicted items — their futures must
-        be failed AFTER the lock is released (Future callbacks run inline) —
-        or [] if enough capacity cannot be freed this way."""
+        evicting queued items in brownout order — (1) batch-class work when
+        the incoming item is interactive, (2) work from currently over-quota
+        tenants, (3) strictly-lower-priority items (higher ``priority`` int =
+        less important) — each tier scanning from the back of its candidates
+        (newest, least sunk wait first). In-SLO interactive work is only ever
+        displaced by the pre-tenancy priority rule, so single-tenant
+        deployments see exactly the old behavior. Returns the evicted items —
+        their futures must be failed AFTER the lock is released (Future
+        callbacks run inline) — or [] if enough capacity cannot be freed."""
         assert self.max_queue_weight is not None
         need = self._queue_weight + weight - self.max_queue_weight
-        victims: List[_Item] = []
+        incoming_interactive = tenant is None or tenant.interactive
+        queued = self._all_items_locked()
+        chosen: List[_Item] = []
+        seen = set()
         freed = 0
-        for it in reversed(self._items):
-            if it is None:
-                continue
-            if it.priority > priority:
-                victims.append(it)
+
+        def take(candidates: List[_Item]) -> bool:
+            nonlocal freed
+            for it in reversed(candidates):
+                if id(it) in seen:
+                    continue
+                seen.add(id(it))
+                chosen.append(it)
                 freed += it.weight
                 if freed >= need:
-                    break
+                    return True
+            return False
+
+        done = False
+        if incoming_interactive:
+            done = take(
+                [it for it in queued if it.tenant is not None and not it.tenant.interactive]
+            )
+        if not done:
+            done = take(
+                [
+                    it
+                    for it in queued
+                    if it.tenant is not None
+                    and (tenant is None or it.tenant.name != tenant.name)
+                    and it.tenant.over_quota()
+                ]
+            )
+        if not done:
+            done = take([it for it in queued if it.priority > priority])
         if freed < need:
             return []
-        for v in victims:
-            self._items.remove(v)
-            self._queue_weight -= v.weight
-        return victims
+        for v in chosen:
+            q = self._queues.get(v.tenant.name) if v.tenant is not None else None
+            if q is not None and v in q.items:
+                q.items.remove(v)
+                self._queue_weight -= v.weight
+        return chosen
 
     def admission_error(self) -> Optional[BaseException]:
         """Lifecycle-state admission gate as a typed error, or None while the
@@ -595,12 +800,15 @@ class EngineScheduler:
 
     def _admit(self, item: _Item) -> bool:
         """Admission control, atomic with the queue append: lifecycle state
-        gate (DRAINING/STOPPED → typed 503), spent-budget rejection, and the
-        ``max_queue_weight`` capacity check with priority-aware eviction.
+        gate (DRAINING/STOPPED → typed 503), spent-budget rejection, the
+        brownout gate (batch-class work shed under sustained overload), and
+        the ``max_queue_weight`` capacity check with tiered eviction.
         Also hosts the ``scheduler.admit`` failpoint. Returns False when the
         item was rejected (its future already carries the typed error)."""
         future = item.future
         _failpoints.fire("scheduler.admit")
+        if item.tenant is None or not isinstance(item.tenant, TenantContext):
+            item.tenant = self._tenancy.resolve(item.tenant)
         if item.budget is not None and item.budget.should_abort():
             with self._cv:
                 self._shed += 1
@@ -609,6 +817,7 @@ class EngineScheduler:
             return False
         evicted: List[_Item] = []
         rejection: Optional[BaseException] = None
+        brownout_shed = False
         with self._cv:
             if self._state is ServerState.STOPPED:
                 rejection = BackendUnavailableError(
@@ -618,11 +827,29 @@ class EngineScheduler:
                 rejection = ServerDrainingError(
                     "server is draining; retry against another replica"
                 )
+            elif not item.tenant.interactive and self._brownout_locked():
+                # Brownout: batch-class tenants are shed before any capacity
+                # arithmetic — their retry hint is their own refill horizon
+                # (or the global drain estimate when unlimited), never the
+                # interactive backlog's.
+                brownout_shed = True
+                horizon = item.tenant.refill_horizon(item.weight)
+                rejection = RateLimitError(
+                    f"brownout: batch-class tenant {item.tenant.name!r} shed "
+                    f"under sustained overload (queue weight "
+                    f"{self._queue_weight}/{self.max_queue_weight})",
+                    retry_after=min(
+                        60.0,
+                        max(0.1, horizon or self._retry_after(item.weight)),
+                    ),
+                )
             elif (
                 self.max_queue_weight is not None
                 and self._queue_weight + item.weight > self.max_queue_weight
             ):
-                evicted = self._try_evict_for(item.weight, item.priority)
+                evicted = self._try_evict_for(
+                    item.weight, item.priority, item.tenant
+                )
                 if not evicted and (
                     self._queue_weight + item.weight > self.max_queue_weight
                 ):
@@ -633,22 +860,30 @@ class EngineScheduler:
                         retry_after=self._retry_after(item.weight),
                     )
             if rejection is None:
-                self._items.append(item)
+                self._queue_for_locked(item.tenant).items.append(item)
                 self._queue_weight += item.weight
                 self._shed += len(evicted)
                 self._shed_over_capacity += len(evicted)
                 self._evicted += len(evicted)
+                for v in evicted:
+                    self._tenant_count_locked(v.tenant, "evicted")
                 self._cv.notify()
             else:
                 self._shed += 1
-                if isinstance(rejection, RateLimitError):
+                if brownout_shed:
+                    self._shed_brownout += 1
+                    self._tenant_count_locked(item.tenant, "shed_brownout")
+                elif isinstance(rejection, RateLimitError):
                     self._shed_over_capacity += 1
+                    self._tenant_count_locked(item.tenant, "shed_over_capacity")
         # Futures are completed outside the lock: set_exception runs caller
         # callbacks inline, and a callback that re-enters the scheduler
         # (e.g. a retry) must not deadlock on self._cv.
         if evicted:
             FAILURE_EVENTS.record("scheduler.shed_over_capacity", len(evicted))
             for v in evicted:
+                if v.tenant is not None:
+                    TENANT_EVENTS.record(f"tenant.evicted.{v.tenant.name}")
                 if not v.future.done():
                     v.future.set_exception(
                         RateLimitError(
@@ -657,8 +892,14 @@ class EngineScheduler:
                         )
                     )
         if rejection is not None:
-            if isinstance(rejection, RateLimitError):
+            if brownout_shed:
+                FAILURE_EVENTS.record("scheduler.shed")
+                TENANT_EVENTS.record(f"tenant.shed_brownout.{item.tenant.name}")
+            elif isinstance(rejection, RateLimitError):
                 FAILURE_EVENTS.record("scheduler.shed_over_capacity")
+                TENANT_EVENTS.record(
+                    f"tenant.shed_over_capacity.{item.tenant.name}"
+                )
             else:
                 FAILURE_EVENTS.record("scheduler.shed_draining")
             future.set_exception(rejection)
@@ -666,18 +907,68 @@ class EngineScheduler:
         return True
 
     def _put(self, item: Optional[_Item]) -> None:
+        """Post the shutdown signal (``None``) or re-queue an item directly
+        (no admission control — internal requeues only). The signal is a flag
+        rather than an in-queue sentinel, with the same FIFO contract: the
+        worker serves the whole backlog present at signal time, then retires."""
         with self._cv:
-            self._items.append(item)
+            if item is None:
+                self._sentinel = True
+            else:
+                if not isinstance(item.tenant, TenantContext):
+                    item.tenant = self._tenancy.resolve(item.tenant)
+                self._queue_for_locked(item.tenant).items.append(item)
+                self._queue_weight += item.weight
             self._cv.notify()
+
+    # -- tenant quota ------------------------------------------------------
+    def charge_tenant_quota(
+        self, tenant: Any = None, rows: int = 0
+    ) -> TenantContext:
+        """Charge one request + ``rows`` device rows against the tenant's
+        token buckets, resolving ``tenant`` (name, context, or None) through
+        this scheduler's :class:`TenancyConfig`. On success returns the
+        resolved context for threading through the decode path. On a quota
+        miss — real, or forced by the keyed ``scheduler.tenant=exhaust``
+        failpoint — raises a typed 429 whose ``retry_after`` is the tenant's
+        OWN bucket-refill horizon, not the global drain-rate estimate: a
+        tenant that exhausted its budget learns when *its* budget refills,
+        regardless of how fast the shared queue is moving."""
+        ctx = self._tenancy.resolve(tenant)
+        spec = _failpoints.fire_keyed("scheduler.tenant", ctx.name)
+        forced = spec is not None and spec.action == "exhaust"
+        if forced:
+            wait: Optional[float] = ctx.refill_horizon(rows)
+        else:
+            wait = ctx.try_admit(rows)
+        if forced or wait is not None:
+            retry = min(60.0, max(0.1, float(wait or 0.0)))
+            with self._cv:
+                self._shed += 1
+                self._shed_quota += 1
+                self._tenant_count_locked(ctx, "shed_quota")
+            FAILURE_EVENTS.record("scheduler.shed")
+            TENANT_EVENTS.record(f"tenant.shed_quota.{ctx.name}")
+            raise RateLimitError(
+                f"tenant {ctx.name!r} over quota"
+                + (" (forced by failpoint)" if forced else "")
+                + f"; bucket refills in {retry:.2f}s",
+                retry_after=retry,
+            )
+        TENANT_EVENTS.record(f"tenant.admitted.{ctx.name}")
+        return ctx
 
     def submit(
         self,
         fn: Callable[[], Any],
         budget: Optional[RequestBudget] = None,
         priority: int = 0,
+        tenant: Any = None,
     ) -> Future:
         future: Future = Future()
-        self._admit(_Item(future, fn=fn, budget=budget, priority=priority))
+        self._admit(
+            _Item(future, fn=fn, budget=budget, priority=priority, tenant=tenant)
+        )
         return future
 
     def submit_batched(
@@ -691,6 +982,7 @@ class EngineScheduler:
         priority: int = 0,
         max_rows: Optional[int] = None,
         trace_phase: str = "decode",
+        tenant: Any = None,
     ) -> Future:
         """Enqueue ``payload`` for batched service. Items whose ``batch_key``
         matches the queue head's coalesce into ONE ``batch_fn(payloads)`` call
@@ -710,7 +1002,11 @@ class EngineScheduler:
         item joins — the backend's HBM memory model passes its estimate here.
         ``trace_phase`` names the request-trace span the group's runner time
         is attributed to ("decode" for generation launches; embeddings pass
-        "embed" so consolidation-time forwards don't read as decode)."""
+        "embed" so consolidation-time forwards don't read as decode).
+        ``tenant`` (name, :class:`TenantContext`, or None for the default
+        tenant) routes the item to its tenant's WFQ queue; coalescing never
+        crosses tenant boundaries. Quotas are NOT charged here — the request
+        path charges once via :meth:`charge_tenant_quota` before submitting."""
         future: Future = Future()
         self._admit(
             _Item(
@@ -723,6 +1019,7 @@ class EngineScheduler:
                 budget=budget,
                 priority=priority,
                 max_rows=max_rows,
+                tenant=tenant,
                 trace_phase=trace_phase,
             )
         )
@@ -751,6 +1048,7 @@ class EngineScheduler:
         priority: int = 0,
         max_rows: Optional[int] = None,
         trace_phase: str = "decode",
+        tenant: Any = None,
     ) -> Any:
         """Synchronous batched submit-and-wait (re-entrant like ``call``).
         Per-member failures surface here: if the runner returned an exception
@@ -772,6 +1070,7 @@ class EngineScheduler:
             priority=priority,
             max_rows=max_rows,
             trace_phase=trace_phase,
+            tenant=tenant,
         ).result()
 
     # -- lifecycle & observability ----------------------------------------
@@ -784,7 +1083,7 @@ class EngineScheduler:
     def stats(self) -> Dict[str, Any]:
         with self._cv:
             out = {
-                "queued": len(self._items),
+                "queued": self._backlog_locked(),
                 "served": self._served,
                 "errors": self._errors,
                 "batches": self._batches,
@@ -842,9 +1141,23 @@ class EngineScheduler:
         """Point-in-time lifecycle snapshot, shaped for a /healthz endpoint.
         Cheap (one lock acquisition, no device work)."""
         with self._cv:
+            tenants: Dict[str, Any] = {}
+            for name, tq in self._queues.items():
+                entry: Dict[str, Any] = {
+                    "slo": tq.ctx.slo,
+                    "weight": tq.ctx.weight,
+                    "queued": len(tq.items),
+                    "queued_weight": sum(it.weight for it in tq.items),
+                    "vpass": round(tq.vpass, 3),
+                }
+                entry.update(self._tenant_stats.get(name, {}))
+                tenants[name] = entry
+            for name, counts in self._tenant_stats.items():
+                if name not in tenants:
+                    tenants[name] = dict(counts)
             out = {
                 "state": self._state.value,
-                "queue_depth": sum(1 for it in self._items if it is not None),
+                "queue_depth": self._backlog_locked(),
                 "queue_weight": self._queue_weight,
                 "max_queue_weight": self.max_queue_weight,
                 "in_flight": self._in_flight,
@@ -854,7 +1167,11 @@ class EngineScheduler:
                 "errors": self._errors,
                 "shed": self._shed,
                 "shed_over_capacity": self._shed_over_capacity,
+                "shed_brownout": self._shed_brownout,
+                "shed_quota": self._shed_quota,
+                "brownout": self._brownout_locked(),
                 "evicted": self._evicted,
+                "tenants": tenants,
                 "oom_splits": self._oom_splits,
                 "recoveries": self._recoveries,
                 "recovery_attempt": self._recovery_attempt,
@@ -887,15 +1204,13 @@ class EngineScheduler:
             self._state = ServerState.DRAINING
             self._cv.notify_all()  # wake the worker's idle wait
             clean = True
-            while self._items or self._in_flight:
+            while self._backlog_locked() or self._in_flight:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     clean = False
                     break
                 self._cv.wait(remaining)
-            leftovers = [it for it in self._items if it is not None]
-            self._items.clear()
-            self._queue_weight = 0
+            leftovers = self._clear_queues_locked()
         for it in leftovers:
             if not it.future.done():
                 it.future.set_exception(
@@ -913,9 +1228,9 @@ class EngineScheduler:
         return clean
 
     def shutdown(self) -> None:
-        """Legacy stop: post the FIFO sentinel (backlog is served first) and
-        join. Kept for back-compat; ``drain()`` is the graceful variant with
-        admission close and timeout semantics."""
+        """Legacy stop: post the shutdown signal (backlog is served first)
+        and join. Kept for back-compat; ``drain()`` is the graceful variant
+        with admission close and timeout semantics."""
         self._put(None)
         self._worker.join(timeout=5)
         with self._cv:

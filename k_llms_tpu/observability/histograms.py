@@ -124,6 +124,14 @@ class LatencyHistograms:
 #: fused path included), observed around the supervised generate_many call;
 #: consensus.consolidate — consensus consolidation wall time. All observes
 #: are host-side wall clock — never inside jitted step programs.
+#:
+#: The ``.*`` wildcard families are the per-tenant label sets (ISSUE 16):
+#: ``request.e2e.<tenant>`` / ``request.ttft.<tenant>`` /
+#: ``scheduler.queue_wait.<tenant>`` record the same observation a second
+#: time under the request's tenant, and ``/metrics`` renders them as one
+#: labeled family per base name (``kllms_request_e2e_by_tenant_seconds``
+#: with a ``tenant`` label) so per-tenant SLO compliance is scrapeable
+#: without pre-registering tenant names.
 LATENCY = LatencyHistograms(declared=(
     "request.e2e",
     "request.ttft",
@@ -131,4 +139,7 @@ LATENCY = LatencyHistograms(declared=(
     "continuous.step",
     "engine.decode_launch",
     "consensus.consolidate",
+    "request.e2e.*",
+    "request.ttft.*",
+    "scheduler.queue_wait.*",
 ))

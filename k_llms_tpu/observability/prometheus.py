@@ -92,6 +92,36 @@ def histogram_family(name: str, help_text: str, snap: Mapping[str, Any]) -> Fami
     }
 
 
+def labeled_histogram_family(
+    name: str,
+    help_text: str,
+    snaps: Mapping[str, Mapping[str, Any]],
+    label: str = "tenant",
+) -> FamilyDict:
+    """One histogram family carrying a label dimension: each entry of
+    ``snaps`` (label value → ``LatencyHistograms.snapshot()`` entry) emits a
+    full ``_bucket``/``_sum``/``_count`` triple with ``label`` merged into
+    every sample. Prometheus requires one HELP/TYPE per family, so per-tenant
+    histograms must share a family rather than minting one per tenant; label
+    values are escaped at render time (hostile tenant ids included)."""
+    samples: List[Tuple[str, Dict[str, Any], Any]] = []
+    for value in sorted(snaps):
+        snap = snaps[value]
+        for bound, cumulative in snap["buckets"]:
+            samples.append(
+                ("_bucket", {label: value, "le": format_bound(bound)}, cumulative)
+            )
+        samples.append(("_bucket", {label: value, "le": "+Inf"}, snap["count"]))
+        samples.append(("_sum", {label: value}, snap["sum"]))
+        samples.append(("_count", {label: value}, snap["count"]))
+    return {
+        "name": name,
+        "type": "histogram",
+        "help": help_text,
+        "samples": samples,
+    }
+
+
 def render_families(families: Iterable[FamilyDict]) -> str:
     """The full exposition body. Families render in the order given; each
     emits HELP and TYPE even when it currently has no samples, so the scrape

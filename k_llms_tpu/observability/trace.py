@@ -319,15 +319,21 @@ class Tracer:
         status: Any,
         n: Optional[int] = None,
         error: Optional[BaseException] = None,
+        tenant: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         """Close a trace exactly once: observe e2e latency, flight-record.
         Re-finishing (or finishing a noop trace) is a no-op, which is what
-        makes "exactly one record per request" hold across owners."""
+        makes "exactly one record per request" hold across owners. When the
+        request carried a tenant, the same e2e lands a second time in the
+        per-tenant family (``request.e2e.<tenant>``) for the labeled
+        ``/metrics`` exposition."""
         if trace is None or trace.noop or not trace.mark_finished():
             return None
         e2e = trace.elapsed_s()
         if self._latency is not None:
             self._latency.observe("request.e2e", e2e)
+            if tenant:
+                self._latency.observe(f"request.e2e.{tenant}", e2e)
         record: Dict[str, Any] = {
             "trace_id": trace.trace_id,
             "span_id": trace.span_id,
@@ -340,6 +346,8 @@ class Tracer:
             "phases": trace.as_dict(),
             "annotations": trace.annotations_snapshot(),
         }
+        if tenant:
+            record["tenant"] = tenant
         if error is not None:
             record["error"] = f"{type(error).__name__}: {error}"[:500]
         if self._recorder is not None:

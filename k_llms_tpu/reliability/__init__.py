@@ -12,6 +12,7 @@ from .deadline import Deadline, RequestBudget
 from .failpoints import FailSpec, failpoints as failpoint_scope
 from .retry import CircuitBreaker, RetryPolicy, is_retryable
 from .supervisor import EngineSupervisor, LaunchBudgetModel
+from .tenancy import TenancyConfig, TenantContext, TenantSpec, TokenBucket
 
 __all__ = [
     "CircuitBreaker",
@@ -21,6 +22,10 @@ __all__ = [
     "LaunchBudgetModel",
     "RequestBudget",
     "RetryPolicy",
+    "TenancyConfig",
+    "TenantContext",
+    "TenantSpec",
+    "TokenBucket",
     "failpoint_scope",
     "failpoints",
     "is_retryable",

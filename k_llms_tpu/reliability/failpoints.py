@@ -86,6 +86,13 @@ Injection sites wired in this package:
                            request (no timings, no flight record) while the
                            request itself completes untouched — the contract
                            under drill is that tracing never fails a request
+- ``scheduler.tenant``   — evaluated (keyed by tenant name) when the
+                           scheduler charges a request against its tenant's
+                           token buckets (``engine/scheduler.py``); the
+                           ``exhaust`` action forces a quota miss for the
+                           named tenant so the typed 429 path — bucket-refill
+                           ``retry_after``, per-tenant shed counters — is
+                           exercisable without actually draining a bucket
 
 Actions (``FailSpec.action``):
 
@@ -130,6 +137,11 @@ Actions (``FailSpec.action``):
                        and hands out a no-op trace (spans, annotations, and
                        the flight record all degrade to nothing) while the
                        request proceeds normally
+- ``"exhaust"``      — no-op at the site itself; the scheduler's tenant-quota
+                       charge reads the spec and treats the named tenant's
+                       buckets as empty for that request (typed 429 with the
+                       bucket's own refill ``retry_after``), keyed by tenant
+                       name like the replica sites
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -150,11 +162,13 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="continuous.step=hang:1:3"
     KLLMS_FAILPOINTS="continuous.worker=crash:1"
     KLLMS_FAILPOINTS="serving.trace=drop:2"
+    KLLMS_FAILPOINTS="scheduler.tenant=exhaust:bulk:2"
 where the first numeric arg is ``times`` for
 raise/sleep/oom/corrupt/disconnect/fallback/drop/crash specs (crash defaults to
 firing once), ``times[:delay]`` for hang, ``kill[:seed]`` for
 kill_samples/nan, ``kill`` (pages to drop) for leak, and ``member[:times]``
-for down/fail (replica sites are keyed by replica id).
+for down/fail/exhaust (keyed sites: replica sites by replica id,
+``scheduler.tenant`` by tenant name).
 """
 
 from __future__ import annotations
@@ -188,6 +202,7 @@ SITES = (
     "continuous.step",
     "continuous.worker",
     "serving.trace",
+    "scheduler.tenant",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the
@@ -210,6 +225,7 @@ def _injected_oom() -> BaseException:
 class FailSpec:
     # "raise" | "oom" | "sleep" | "hang" | "kill_samples" | "nan" | "corrupt"
     # | "down" | "fail" | "disconnect" | "leak" | "fallback" | "crash"
+    # | "drop" | "exhaust"
     action: str = "raise"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
@@ -237,6 +253,7 @@ class FailSpec:
             "fallback",
             "crash",
             "drop",
+            "exhaust",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -381,7 +398,7 @@ def configure_from_env(env: Optional[str] = None) -> None:
             # Unbounded crash specs are rebuild storms, not drills: default 1.
             times = int(args[0]) if args else 1
             specs[site] = FailSpec(action="crash", times=times)
-        elif action in ("down", "fail"):
+        elif action in ("down", "fail", "exhaust"):
             member = args[0] if args and args[0] else None
             times = int(args[1]) if len(args) > 1 else None
             specs[site] = FailSpec(action=action, member=member, times=times)

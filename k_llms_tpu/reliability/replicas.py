@@ -525,6 +525,20 @@ class ReplicaSet(Backend):
         handle.note_success(time.perf_counter() - t0)
         return out
 
+    def _batch_class(self, handle: ReplicaHandle, request: ChatRequest) -> bool:
+        """True when this request's tenant is SLO class ``batch`` on the
+        routed member. Batch work never hedges: duplicating it on a second
+        member would spend tail-latency capacity on traffic that by contract
+        doesn't have a tail SLO. Defaults to interactive on any lookup
+        failure (a backend without tenancy hedges as before)."""
+        try:
+            tenancy = getattr(handle.backend, "tenancy", None)
+            if tenancy is None or request.tenant is None:
+                return False
+            return not tenancy.resolve(request.tenant).interactive
+        except Exception:
+            return False
+
     def _hedge_delay(self, handle: ReplicaHandle) -> Optional[float]:
         """Seconds to wait before duplicating on a second member; None
         disables hedging for this dispatch (off, solo set, or no latency
@@ -542,7 +556,7 @@ class ReplicaSet(Backend):
         self, primary: ReplicaHandle, request: ChatRequest
     ) -> ChatCompletion:
         delay = self._hedge_delay(primary)
-        if delay is None:
+        if delay is None or self._batch_class(primary, request):
             return self._attempt(primary, request, hedged=False)
 
         parent = request.budget

@@ -88,6 +88,7 @@ def _build_request(
     response_format: Optional[Any],
     kwargs: dict,
     timeout: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> ChatRequest:
     kwargs = dict(kwargs)
     # ``stream`` is an explicit parameter of create()/parse() now; anything
@@ -154,6 +155,7 @@ def _build_request(
         seed=seed,
         response_format=response_format,
         budget=budget,
+        tenant=tenant,
         extra=kwargs,
     )
 
@@ -222,6 +224,8 @@ class ChatCompletionStream:
             self._first_delta_seen = True
             ttft = time.monotonic() - self._t0
             LATENCY.observe("request.ttft", ttft)
+            if self._request.tenant:
+                LATENCY.observe(f"request.ttft.{self._request.tenant}", ttft)
             self.trace.annotate("ttft_s", round(ttft, 6))
         self._events.put(("delta", sample_idx, delta))
 
@@ -258,12 +262,17 @@ class ChatCompletionStream:
                     status="error",
                     n=self._request.n,
                     error=e,
+                    tenant=self._request.tenant,
                 )
             self._events.put(("error", e))
         else:
             if self._owns_trace:
                 TRACER.finish(
-                    self.trace, route="stream", status="ok", n=self._request.n
+                    self.trace,
+                    route="stream",
+                    status="ok",
+                    n=self._request.n,
+                    tenant=self._request.tenant,
                 )
             self._events.put(("done", None))
 
@@ -354,7 +363,11 @@ class ChatCompletionStream:
             # No-op if the worker already finished the trace normally
             # (mark_finished is first-caller-wins).
             TRACER.finish(
-                self.trace, route="stream", status="aborted", n=self._request.n
+                self.trace,
+                route="stream",
+                status="aborted",
+                n=self._request.n,
+                tenant=self._request.tenant,
             )
         if self._request.budget is not None:
             self._request.budget.cancel()
@@ -432,6 +445,7 @@ class Completions:
         consensus_settings: Optional[ConsensusSettings] = None,
         timeout: Optional[float] = None,
         stream: bool = False,
+        tenant: Optional[str] = None,
         **kwargs: Any,
     ) -> Union[KLLMsChatCompletion, ChatCompletionStream]:
         settings = consensus_settings or ConsensusSettings()
@@ -440,7 +454,7 @@ class Completions:
         request = _build_request(
             messages, model or self._wrapper.default_model, n, temperature, max_tokens,
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
-            timeout=timeout,
+            timeout=timeout, tenant=tenant,
         )
         if stream:
             backend = self._wrapper.backend
@@ -482,12 +496,16 @@ class Completions:
         except BaseException as e:
             if owned:
                 TRACER.finish(
-                    trace, route="create", status="error", n=request.n, error=e
+                    trace, route="create", status="error", n=request.n,
+                    error=e, tenant=request.tenant,
                 )
             raise
         result = _attach_trace(result, trace, self._wrapper.backend)
         if owned:
-            TRACER.finish(trace, route="create", status="ok", n=request.n)
+            TRACER.finish(
+                trace, route="create", status="ok", n=request.n,
+                tenant=request.tenant,
+            )
         return result
 
     def parse(
@@ -507,6 +525,7 @@ class Completions:
         consensus_settings: Optional[ConsensusSettings] = None,
         timeout: Optional[float] = None,
         stream: bool = False,
+        tenant: Optional[str] = None,
         **kwargs: Any,
     ) -> KLLMsParsedChatCompletion:
         if stream:
@@ -524,7 +543,7 @@ class Completions:
         request = _build_request(
             messages, model or self._wrapper.default_model, n, temperature, max_tokens,
             top_p, frequency_penalty, presence_penalty, stop, seed, response_format, kwargs,
-            timeout=timeout,
+            timeout=timeout, tenant=tenant,
         )
         trace, owned = TRACER.current_or_start()
         try:
@@ -549,12 +568,16 @@ class Completions:
         except BaseException as e:
             if owned:
                 TRACER.finish(
-                    trace, route="parse", status="error", n=request.n, error=e
+                    trace, route="parse", status="error", n=request.n,
+                    error=e, tenant=request.tenant,
                 )
             raise
         result = _attach_trace(result, trace, self._wrapper.backend)
         if owned:
-            TRACER.finish(trace, route="parse", status="ok", n=request.n)
+            TRACER.finish(
+                trace, route="parse", status="ok", n=request.n,
+                tenant=request.tenant,
+            )
         return result
 
 

@@ -58,11 +58,21 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..observability import prometheus as _prom
 from ..reliability import failpoints as _failpoints
+from ..reliability.tenancy import permissive as _permissive_tenancy
 from ..types.wire import InvalidRequestError, KLLMsError, RateLimitError
 from ..utils import observability as _obs
 from . import sse
 
 logger = logging.getLogger(__name__)
+
+#: Fallback tenant registry for backends that don't carry one (FakeBackend,
+#: bare test doubles): everything resolves to the unlimited default tenant.
+_DEFAULT_TENANCY = _permissive_tenancy()
+
+#: Latency families that fan out per tenant (``<base>.<tenant>``); rendered on
+#: /metrics as one ``kllms_<base>_by_tenant_seconds`` histogram family with a
+#: ``tenant`` label rather than one unlabeled family per tenant.
+_TENANT_HIST_BASES = ("request.e2e", "request.ttft", "scheduler.queue_wait")
 
 # Request-body keys forwarded to Completions.create. Anything else in the
 # payload is ignored (OpenAI semantics: unknown fields don't fail requests).
@@ -85,6 +95,7 @@ _COUNTER_GROUPS = (
     ("consensus", "CONSENSUS_EVENTS"),
     ("kernel", "KERNEL_EVENTS"),
     ("grammar", "GRAMMAR_EVENTS"),
+    ("tenant", "TENANT_EVENTS"),
 )
 
 #: Upper bound for a POST /debug/profile capture; anything longer belongs in
@@ -187,12 +198,33 @@ class ServingApp:
             ))
         # Latency histograms (LATENCY): exactly-declared families export even
         # at zero samples, so the scrape surface is stable from first poll.
+        # Per-tenant fan-outs (``request.e2e.<tenant>``...) fold into ONE
+        # labeled family per base — tenant ids become escaped label values,
+        # never metric names (hostile API keys can't corrupt the exposition).
+        tenant_snaps: Dict[str, Dict[str, Any]] = {
+            base: {} for base in _TENANT_HIST_BASES
+        }
         for fam, snap in sorted(_obs.LATENCY.snapshot().items()):
+            base = next(
+                (b for b in _TENANT_HIST_BASES if fam.startswith(b + ".")),
+                None,
+            )
+            if base is not None:
+                tenant_snaps[base][fam[len(base) + 1:]] = snap
+                continue
             families.append(_prom.histogram_family(
                 "kllms_" + fam.replace(".", "_") + "_seconds",
                 f"latency histogram for {fam} (seconds, log-spaced buckets)",
                 snap,
             ))
+        for base, snaps in tenant_snaps.items():
+            if snaps:
+                families.append(_prom.labeled_histogram_family(
+                    "kllms_" + base.replace(".", "_") + "_by_tenant_seconds",
+                    f"per-tenant latency histogram for {base} "
+                    "(seconds, log-spaced buckets; tenant label)",
+                    snaps,
+                ))
         backend = getattr(self.client, "backend", None)
         cont = getattr(backend, "_continuous", None)
         if cont is not None:
@@ -331,15 +363,29 @@ class ServingApp:
         # await/to_thread of this request, and finish it — exactly once —
         # on whichever terminal path the request takes.
         traceparent = None
+        api_key: Optional[str] = None
         for key, value in scope.get("headers") or []:
             if key == b"traceparent":
                 traceparent = value.decode("latin-1")
-                break
+            elif key == b"authorization":
+                auth = value.decode("latin-1")
+                api_key = (
+                    auth[7:].strip()
+                    if auth[:7].lower() == "bearer " else auth.strip()
+                )
+        # Tenant resolution happens HERE, from the API key — never from the
+        # request body, so clients can't claim another tenant's quota or
+        # weight by naming it in JSON. Unmapped keys become their own dynamic
+        # tenant under the default spec (see TenancyConfig.tenant_for_key).
+        backend = getattr(self.client, "backend", None)
+        tenancy = getattr(backend, "tenancy", None) or _DEFAULT_TENANCY
+        tenant = tenancy.tenant_for_key(api_key)
+        _obs.TENANT_EVENTS.record(f"tenant.requests.{tenant}")
         trace = _obs.TRACER.start(traceparent)
         outcome: Dict[str, Any] = {"status": 500, "n": None, "error": None}
         try:
             with _obs.use_trace(trace):
-                await self._chat_inner(receive, send, outcome)
+                await self._chat_inner(receive, send, outcome, tenant)
         except ClientDisconnected:
             outcome["status"] = "disconnect"
             raise
@@ -350,10 +396,11 @@ class ServingApp:
                 status=outcome["status"],
                 n=outcome["n"],
                 error=outcome["error"],
+                tenant=tenant,
             )
 
     async def _chat_inner(
-        self, receive, send, outcome: Dict[str, Any]
+        self, receive, send, outcome: Dict[str, Any], tenant: str
     ) -> None:
         body = await _read_body(receive)
         try:
@@ -382,6 +429,9 @@ class ServingApp:
             return
         stream = bool(payload.get("stream", False))
         params = {k: payload[k] for k in _CREATE_KEYS if payload.get(k) is not None}
+        # Deliberately NOT in _CREATE_KEYS: the header-resolved tenant wins
+        # over anything in the body.
+        params["tenant"] = tenant
         outcome["n"] = payload.get("n")
 
         # Fault injection at the front door. raise/sleep actions fire inside;

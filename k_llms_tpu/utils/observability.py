@@ -301,6 +301,30 @@ STREAM_EVENTS = EventCounters(declared=(
 ))
 
 
+#: Process-wide multi-tenancy counters, all keyed by tenant name
+#: (ISSUE 16). ``tenant.requests.<name>`` — requests attributed to a tenant
+#: at the serving front door; ``tenant.admitted.<name>`` /
+#: ``tenant.served.<name>`` — work that passed quota charge and work that
+#: finished; ``tenant.shed_quota.<name>`` — typed 429s from the tenant's own
+#: token buckets (incl. the ``scheduler.tenant=exhaust`` failpoint);
+#: ``tenant.shed_brownout.<name>`` — batch-class work shed while the
+#: scheduler is in brownout; ``tenant.shed_over_capacity.<name>`` /
+#: ``tenant.evicted.<name>`` — per-tenant attribution of the global cap
+#: sheds and priority evictions. Fed by ``engine/scheduler.py`` and
+#: ``serving/app.py``; surfaced on ``/metrics`` as
+#: ``kllms_tenant_events_total`` so fairness and brownout ordering are
+#: provable from scrape output alone.
+TENANT_EVENTS = EventCounters(declared=(
+    "tenant.requests.*",
+    "tenant.admitted.*",
+    "tenant.served.*",
+    "tenant.shed_quota.*",
+    "tenant.shed_brownout.*",
+    "tenant.shed_over_capacity.*",
+    "tenant.evicted.*",
+))
+
+
 def _walk_confidences(node: Any, out: List[float]) -> None:
     if isinstance(node, dict):
         for v in node.values():

@@ -1,0 +1,118 @@
+"""Prometheus label escaping for hostile tenant ids (ISSUE 16 satellite).
+
+Unmapped API keys become their own dynamic tenant names, and tenant names
+become ``tenant=\"...\"`` label VALUES on the per-tenant histogram and event
+families — so an adversarial Authorization header (double quotes, backslashes,
+newlines) flows straight toward the ``/metrics`` exposition. These tests pin
+that such ids are escaped per the 0.0.4 text format and can never break a
+sample line, inject fake samples, or smuggle a newline into the scrape.
+"""
+
+import asyncio
+
+import httpx
+
+from k_llms_tpu import KLLMs
+from k_llms_tpu.backends.fake import FakeBackend
+from k_llms_tpu.observability.prometheus import (
+    escape_label_value,
+    labeled_histogram_family,
+    render_families,
+)
+from k_llms_tpu.serving import ServingApp
+
+BODY = {
+    "messages": [{"role": "user", "content": "say something"}],
+    "model": "fake-model",
+    "n": 2,
+    "seed": 3,
+}
+
+#: Hostile tenant id: every character class the exposition format escapes,
+#: plus an attempted sample-line injection after a newline.
+HOSTILE = 'ten"ant\\evil\nkllms_fake_total{x="y"} 999'
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _asgi(app):
+    return httpx.AsyncClient(
+        transport=httpx.ASGITransport(app=app), base_url="http://testserver"
+    )
+
+
+def test_escape_label_value_order_and_coverage():
+    # Backslash first (or the quote escape would be double-escaped), then
+    # quote, then newline.
+    assert escape_label_value('a\\b"c\nd') == 'a\\\\b\\"c\\nd'
+    assert escape_label_value(HOSTILE).count("\n") == 0
+
+
+def test_labeled_family_renders_hostile_tenant_on_one_line():
+    snap = {"buckets": [(0.1, 1), (1.0, 2)], "sum": 0.3, "count": 2}
+    fam = labeled_histogram_family(
+        "kllms_request_e2e_by_tenant_seconds", "per-tenant e2e", {HOSTILE: snap}
+    )
+    text = render_families([fam])
+    lines = text.strip().split("\n")
+    # 2 meta lines + 3 buckets (incl +Inf) + _sum + _count — the embedded
+    # newline in the tenant id must NOT have minted extra lines.
+    assert len(lines) == 7
+    for line in lines[2:]:
+        assert line.startswith("kllms_request_e2e_by_tenant_seconds")
+        assert 'tenant="ten\\"ant\\\\evil\\nkllms_fake_total{x=\\"y\\"} 999"' in line
+    # The injection payload never appears as its own sample.
+    assert "\nkllms_fake_total" not in text
+
+
+def test_hostile_api_key_cannot_corrupt_metrics_scrape():
+    from k_llms_tpu.utils.observability import LATENCY, TENANT_EVENTS
+
+    client = KLLMs(
+        backend=FakeBackend(["alpha beta", "alpha"]), model="fake-model"
+    )
+    app = ServingApp(client)
+
+    async def go():
+        async with _asgi(app) as c:
+            # httpx forbids raw newlines in header values, so exercise the
+            # quote/backslash classes over HTTP...
+            r = await c.post(
+                "/v1/chat/completions",
+                json=BODY,
+                headers={"Authorization": 'Bearer k"ey\\with"quotes'},
+            )
+            assert r.status_code == 200
+            return await c.get("/metrics")
+
+    try:
+        resp = _run(go())
+        assert resp.status_code == 200
+        text = resp.text
+        assert 'tenant="k\\"ey\\\\with\\"quotes"' in text
+        # ...and the newline class through the tracer/counter path directly:
+        # observations carrying the fully hostile tenant id still render one
+        # sample per line and every line parses as `name{labels} value`.
+        LATENCY.observe(f"request.e2e.{HOSTILE}", 0.25)
+        TENANT_EVENTS.record(f"tenant.requests.{HOSTILE}")
+        resp2 = _run(_scrape(app))
+        for line in resp2.text.strip().split("\n"):
+            assert line, "blank line injected into exposition"
+            if line.startswith("#"):
+                continue
+            name_and_labels, _, value = line.rpartition(" ")
+            assert name_and_labels and not name_and_labels.startswith("{")
+            float(value)  # every sample line ends in a parseable number
+        assert 'kllms_fake_total{x="y"} 999' not in resp2.text
+    finally:
+        # Hostile ids live in process-global counters; don't leak them into
+        # other tests' scrapes.
+        LATENCY.reset()
+        TENANT_EVENTS.reset()
+
+
+async def _scrape(app):
+    async with _asgi(app) as c:
+        return await c.get("/metrics")

@@ -1,0 +1,447 @@
+"""Multi-tenant isolation (ISSUE 16 tentpole).
+
+Fast deterministic coverage of the tenancy subsystem and its scheduler
+integration: token buckets under an injected clock, registry resolution
+(configured / dynamic / API-key), quota 429s whose ``retry_after`` is the
+tenant's OWN bucket refill (not the global drain estimate), the keyed
+``scheduler.tenant=exhaust`` failpoint, weighted-fair dequeue across tenant
+queues, interactive-before-batch ordering, brownout shedding of batch-class
+work, tiered eviction (batch first, then over-quota tenants, then priority),
+and the drained-rate fix (shed work never inflates the drain estimate).
+"""
+
+import threading
+import time
+
+import pytest
+
+from k_llms_tpu.engine.scheduler import EngineScheduler
+from k_llms_tpu.reliability.deadline import RequestBudget
+from k_llms_tpu.reliability.failpoints import FailSpec, failpoints
+from k_llms_tpu.reliability.tenancy import (
+    DEFAULT_TENANT,
+    TenancyConfig,
+    TenantContext,
+    TenantSpec,
+    TokenBucket,
+)
+from k_llms_tpu.types import RateLimitError
+
+
+class _Clock:
+    """Injectable monotonic clock."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _echo(payloads):
+    return list(payloads)
+
+
+def _blocked_scheduler(**kwargs):
+    """A scheduler whose worker is parked on an Event, so queued items stay
+    queued until the test releases the gate."""
+    sched = EngineScheduler(name="test", batch_window=0.0, **kwargs)
+    gate = threading.Event()
+    blocker = sched.submit(gate.wait)
+    for _ in range(200):
+        if sched.stats["queued"] == 0 and blocker.running():
+            break
+        time.sleep(0.005)
+    return sched, gate, blocker
+
+
+# ---------------------------------------------------------------------------
+# token buckets
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_burst_and_refill():
+    clock = _Clock()
+    b = TokenBucket(rate=2.0, burst=4.0, clock=clock)
+    assert b.level() == 4.0
+    assert b.try_take(4.0)
+    assert not b.try_take(1.0)  # empty; level untouched by the failed take
+    assert b.time_until(1.0) == pytest.approx(0.5)  # 1 token / 2 per s
+    clock.advance(0.5)
+    assert b.try_take(1.0)
+    clock.advance(100.0)
+    assert b.level() == 4.0  # refill clamps at burst
+
+
+def test_token_bucket_over_burst_cost_reports_finite_horizon():
+    clock = _Clock()
+    b = TokenBucket(rate=1.0, burst=2.0, clock=clock)
+    b.try_take(2.0)
+    # A cost that can never fit still gets the full-burst horizon, not inf.
+    assert b.time_until(100.0) == pytest.approx(2.0)
+
+
+def test_token_bucket_validation():
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0.0, burst=1.0)
+    with pytest.raises(ValueError):
+        TokenBucket(rate=1.0, burst=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# specs + registry resolution
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_spec_validation():
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", slo="gold")
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", weight=0.0)
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", requests_per_s=-1.0)
+    with pytest.raises(ValueError):
+        TenantSpec(name="")
+
+
+def test_registry_resolution_and_overrides():
+    cfg = TenancyConfig.from_options(
+        default_weight=1.0,
+        default_requests_per_s=10.0,
+        tenants={"bulk": {"slo": "batch", "weight": 2.0, "rows_per_s": 8.0}},
+        api_keys={"sk-abc": "bulk"},
+    )
+    default = cfg.resolve(None)
+    assert default.name == DEFAULT_TENANT
+    assert default.interactive and default.limited
+    bulk = cfg.resolve("bulk")
+    assert not bulk.interactive
+    assert bulk.weight == 2.0
+    # Overrides inherit unset fields from the default spec.
+    assert bulk.spec.requests_per_s == 10.0
+    # Same name resolves to the SAME live context (shared bucket state).
+    assert cfg.resolve("bulk") is bulk
+    # A context passes straight through.
+    assert cfg.resolve(bulk) is bulk
+    # API-key mapping; unmapped keys become their own tenant name.
+    assert cfg.tenant_for_key("sk-abc") == "bulk"
+    assert cfg.tenant_for_key(None) == DEFAULT_TENANT
+    assert cfg.tenant_for_key("") == DEFAULT_TENANT
+    assert cfg.tenant_for_key("sk-unknown") == "sk-unknown"
+    # Dynamic tenants materialize under default policy with their OWN buckets.
+    dyn = cfg.resolve("sk-unknown")
+    assert dyn.name == "sk-unknown"
+    assert dyn.spec.requests_per_s == 10.0
+    assert dyn is not default
+    assert "bulk" in cfg.known_tenants()
+
+
+def test_try_admit_charges_both_buckets_atomically():
+    clock = _Clock()
+    ctx = TenantContext(
+        TenantSpec(
+            name="m", requests_per_s=100.0, rows_per_s=4.0, rows_burst=4.0
+        ),
+        clock=clock,
+    )
+    assert ctx.try_admit(rows=4) is None
+    # Row bucket is empty; request bucket must NOT have been charged for the
+    # rejected attempt (atomicity): horizon reflects rows only.
+    wait = ctx.try_admit(rows=4)
+    assert wait == pytest.approx(1.0)
+    assert ctx.over_quota()
+    snap = ctx.quota_snapshot()
+    assert snap["request_tokens"] == pytest.approx(99.0)
+    assert snap["row_tokens"] == 0.0
+    clock.advance(1.0)
+    assert ctx.refill_horizon(rows=4) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# scheduler quota charging: tenant-owned retry_after
+# ---------------------------------------------------------------------------
+
+
+def test_quota_429_retry_after_is_tenants_own_refill():
+    clock = _Clock()
+    tenancy = TenancyConfig.from_options(
+        tenants={"meter": {"requests_per_s": 0.5, "request_burst": 1.0}},
+        clock=clock,
+    )
+    sched = EngineScheduler(name="t", batch_window=0.0, tenancy=tenancy)
+    try:
+        ctx = sched.charge_tenant_quota("meter")
+        assert isinstance(ctx, TenantContext) and ctx.name == "meter"
+        with pytest.raises(RateLimitError) as ei:
+            sched.charge_tenant_quota("meter")
+        # The hint is THIS tenant's bucket refill (1 token / 0.5 per s = 2 s),
+        # not the global drain-rate estimate.
+        assert ei.value.retry_after == pytest.approx(2.0)
+        # Other tenants are untouched by meter's exhaustion.
+        sched.charge_tenant_quota("other")
+        health = sched.health()
+        assert health["shed_quota"] == 1
+        assert health["tenants"]["meter"]["shed_quota"] == 1
+        clock.advance(2.0)
+        sched.charge_tenant_quota("meter")  # refilled
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_tenant_exhaust_failpoint_is_keyed():
+    sched = EngineScheduler(name="t", batch_window=0.0)
+    try:
+        with failpoints(
+            {"scheduler.tenant": FailSpec(action="exhaust", member="bulk", times=1)}
+        ):
+            # Non-matching tenant: the keyed spec neither fires nor burns times.
+            sched.charge_tenant_quota("chat")
+            with pytest.raises(RateLimitError) as ei:
+                sched.charge_tenant_quota("bulk")
+            assert "forced by failpoint" in str(ei.value)
+            # Unlimited tenant: horizon 0 floors at the 0.1 s minimum hint.
+            assert ei.value.retry_after == pytest.approx(0.1)
+            sched.charge_tenant_quota("bulk")  # times=1 consumed
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair dequeue
+# ---------------------------------------------------------------------------
+
+
+def test_wfq_serves_tenants_by_weight():
+    tenancy = TenancyConfig.from_options(
+        tenants={"gold": {"weight": 3.0}, "bronze": {"weight": 1.0}}
+    )
+    sched, gate, blocker = _blocked_scheduler(tenancy=tenancy)
+    try:
+        order = []
+        futures = []
+        # Bronze enqueues FIRST — under FIFO it would drain first; under WFQ
+        # gold's 3x weight earns ~3 of every 4 early slots.
+        for name in ("bronze", "gold"):
+            for i in range(12):
+                key = (name, i)  # distinct keys: no coalescing across items
+
+                def fn(payloads, _name=name):
+                    order.extend(_name for _ in payloads)
+                    return list(payloads)
+
+                futures.append(
+                    sched.submit_batched(key, i, fn, weight=1, tenant=name)
+                )
+        gate.set()
+        for f in futures:
+            f.result(timeout=30)
+        assert len(order) == 24
+        first12 = order[:12]
+        assert first12.count("gold") >= 8, first12
+        assert first12.count("bronze") >= 1, first12  # no starvation either
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+def test_interactive_class_drains_before_batch():
+    tenancy = TenancyConfig.from_options(
+        tenants={"bulk": {"slo": "batch"}, "chat": {"slo": "interactive"}}
+    )
+    sched, gate, blocker = _blocked_scheduler(tenancy=tenancy)
+    try:
+        order = []
+        futures = []
+        # Bulk enqueues first; chat must still be served strictly first.
+        for name in ("bulk", "chat"):
+            for i in range(6):
+                def fn(payloads, _name=name):
+                    order.extend(_name for _ in payloads)
+                    return list(payloads)
+
+                futures.append(
+                    sched.submit_batched((name, i), i, fn, weight=1, tenant=name)
+                )
+        gate.set()
+        for f in futures:
+            f.result(timeout=30)
+        assert order[:6] == ["chat"] * 6, order
+        health = sched.health()
+        assert health["tenants"]["chat"]["served"] == 6
+        assert health["tenants"]["bulk"]["served"] == 6
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# brownout + tiered eviction
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_sheds_batch_class_with_typed_429():
+    tenancy = TenancyConfig.from_options(tenants={"bulk": {"slo": "batch"}})
+    sched, gate, blocker = _blocked_scheduler(
+        tenancy=tenancy, max_queue_weight=10
+    )
+    try:
+        fillers = [
+            sched.submit_batched(("f", i), i, _echo, weight=3, tenant="chat")
+            for i in range(3)
+        ]  # queued weight 9 >= 0.9 * 10 -> brownout
+        assert sched.health()["brownout"] is True
+        shed = sched.submit_batched(("b", 0), 0, _echo, weight=1, tenant="bulk")
+        with pytest.raises(RateLimitError) as ei:
+            shed.result(timeout=5)
+        assert "brownout" in str(ei.value)
+        assert ei.value.retry_after >= 0.1
+        # In-SLO interactive work still fits under the hard cap.
+        ok = sched.submit_batched(("c", 0), 0, _echo, weight=1, tenant="chat")
+        health = sched.health()
+        assert health["shed_brownout"] == 1
+        assert health["tenants"]["bulk"]["shed_brownout"] == 1
+        gate.set()
+        for f in fillers + [ok]:
+            f.result(timeout=30)
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+def test_eviction_prefers_batch_class_over_equal_priority():
+    tenancy = TenancyConfig.from_options(tenants={"bulk": {"slo": "batch"}})
+    sched, gate, blocker = _blocked_scheduler(
+        tenancy=tenancy, max_queue_weight=4, brownout_high_water=2.0
+    )
+    try:
+        # brownout_high_water=2.0 keeps the brownout gate closed so this
+        # exercises the capacity/eviction path in isolation.
+        bulk = [
+            sched.submit_batched(("b", i), i, _echo, weight=2, tenant="bulk")
+            for i in range(2)
+        ]
+        # Queue full (weight 4/4). An INTERACTIVE arrival at the same
+        # priority evicts batch-class work (tier 1) — pre-tenancy rules would
+        # have shed the newcomer.
+        chat = sched.submit_batched(("c", 0), 0, _echo, weight=2, tenant="chat")
+        evicted = [f for f in bulk if f.done()]
+        assert len(evicted) == 1
+        with pytest.raises(RateLimitError):
+            evicted[0].result()
+        assert sched.health()["tenants"]["bulk"]["evicted"] == 1
+        gate.set()
+        assert chat.result(timeout=30) == 0
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+def test_no_cross_eviction_among_equal_interactive_tenants():
+    tenancy = TenancyConfig.from_options(tenants={"a": {}, "b": {}})
+    sched, gate, blocker = _blocked_scheduler(
+        tenancy=tenancy, max_queue_weight=2, brownout_high_water=2.0
+    )
+    try:
+        held = sched.submit_batched(("a", 0), 0, _echo, weight=2, tenant="a")
+        # Equal class, equal priority, neither over quota: the newcomer is
+        # shed, the queued item survives (the PR 2 contract, per tenant).
+        shed = sched.submit_batched(("b", 0), 0, _echo, weight=2, tenant="b")
+        with pytest.raises(RateLimitError):
+            shed.result(timeout=5)
+        assert not held.done()
+        gate.set()
+        assert held.result(timeout=30) == 0
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+def test_eviction_prefers_over_quota_tenant_second():
+    clock = _Clock()
+    tenancy = TenancyConfig.from_options(
+        tenants={"greedy": {"requests_per_s": 1.0, "request_burst": 1.0}},
+        clock=clock,
+    )
+    sched, gate, blocker = _blocked_scheduler(
+        tenancy=tenancy, max_queue_weight=2, brownout_high_water=2.0
+    )
+    try:
+        # Drain greedy's request bucket so it is over quota, then queue its
+        # item (queued BEFORE the bucket check matters: eviction reads the
+        # live bucket state at arrival time of the newcomer).
+        assert tenancy.resolve("greedy").try_admit() is None
+        held = sched.submit_batched(
+            ("g", 0), 0, _echo, weight=2, tenant="greedy"
+        )
+        assert tenancy.resolve("greedy").over_quota()
+        chat = sched.submit_batched(("c", 0), 0, _echo, weight=2, tenant="chat")
+        assert held.done()  # evicted: over-quota tenant displaced (tier 2)
+        with pytest.raises(RateLimitError):
+            held.result()
+        gate.set()
+        assert chat.result(timeout=30) == 0
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drain-rate excludes shed work (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_drain_rate_excludes_shed_work():
+    sched, gate, blocker = _blocked_scheduler()
+    try:
+        budget = RequestBudget.from_timeout(0.01)
+        futures = [
+            sched.submit_batched(("k", i), i, _echo, weight=4, budget=budget)
+            for i in range(4)
+        ]
+        time.sleep(0.05)  # budgets expire while queued
+        gate.set()
+        for f in futures:
+            with pytest.raises(Exception):
+                f.result(timeout=30)
+        for _ in range(200):
+            if sched.health()["shed"] >= 4:
+                break
+            time.sleep(0.005)
+        health = sched.health()
+        assert health["shed"] >= 4
+        # Every queued item was shed at dequeue: none of that weight reached
+        # the runner, so the drain-rate estimate must not count it (a 429's
+        # global retry hint would otherwise promise capacity that was never
+        # actually served).
+        assert health["drain_rate"] == 0.0
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# health surface
+# ---------------------------------------------------------------------------
+
+
+def test_health_reports_per_tenant_queues_and_quota():
+    tenancy = TenancyConfig.from_options(
+        tenants={"bulk": {"slo": "batch", "weight": 2.0}}
+    )
+    sched, gate, blocker = _blocked_scheduler(tenancy=tenancy)
+    try:
+        f = sched.submit_batched(("b", 0), 0, _echo, weight=3, tenant="bulk")
+        health = sched.health()
+        entry = health["tenants"]["bulk"]
+        assert entry["slo"] == "batch"
+        assert entry["weight"] == 2.0
+        assert entry["queued"] == 1
+        assert entry["queued_weight"] == 3
+        gate.set()
+        f.result(timeout=30)
+    finally:
+        gate.set()
+        sched.shutdown()
