@@ -1245,6 +1245,119 @@ def bench_tenancy() -> dict:
     }
 
 
+def bench_batch_lane() -> dict:
+    """Durable offline batch lane (ISSUE 17, hermetic — FakeBackend with a
+    fixed per-call service time, no device): (a) throughput — a 64-item
+    durable batch job drained by the lane's bounded worker pool vs the same
+    64 bodies executed foreground one at a time; the lane overlaps
+    ``max_in_flight`` items so wall time divides by ~the pool width minus
+    the per-item durable-commit fsyncs, while every output lands exactly
+    once through the crash-safe store; (b)
+    isolation — interactive p50/p99 client latency with the lane off vs
+    grinding a second 64-item job; the pool is bounded, so foreground calls
+    on the same client stay flat instead of queueing behind the backlog."""
+    import shutil
+    import tempfile
+
+    from k_llms_tpu import KLLMs
+    from k_llms_tpu.backends.fake import FakeBackend
+    from k_llms_tpu.reliability.jobstore import JobStore
+    from k_llms_tpu.serving.batch import BatchLane
+
+    work_s, items, in_flight, interactive_n = 0.008, 64, 4, 40
+
+    def quantile(xs: list, q: float) -> float:
+        ordered = sorted(xs)
+        return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1)))]
+
+    client = KLLMs(backend=FakeBackend(), model="fake-model")
+    real_create = client.chat.completions.create
+
+    def timed_create(*args, **kwargs):
+        time.sleep(work_s)  # fixed per-item service time: makes overlap visible
+        return real_create(*args, **kwargs)
+
+    client.chat.completions.create = timed_create
+
+    def job_body(tag: str) -> bytes:
+        return "\n".join(
+            json.dumps({"custom_id": f"{tag}-{i}", "body": {
+                "messages": [{"role": "user", "content": f"{tag} {i}"}],
+                "n": 1, "seed": 1000 + i,
+            }})
+            for i in range(items)
+        ).encode()
+
+    def interactive() -> list:
+        lats = []
+        for i in range(interactive_n):
+            t0 = time.perf_counter()
+            client.chat.completions.create(
+                messages=[{"role": "user", "content": f"interactive {i}"}],
+                model="fake-model", n=1, seed=5000 + i,
+            )
+            lats.append((time.perf_counter() - t0) * 1000.0)
+        return lats
+
+    # (a) Foreground baseline: the same 64 bodies, strictly sequential.
+    t0 = time.perf_counter()
+    for i in range(items):
+        client.chat.completions.create(
+            messages=[{"role": "user", "content": f"foreground {i}"}],
+            model="fake-model", n=1, seed=1000 + i,
+        )
+    foreground_s = time.perf_counter() - t0
+
+    root = tempfile.mkdtemp(prefix="kllms-bench-batch-")
+    lane = BatchLane(client, JobStore(root), max_in_flight=in_flight)
+    try:
+        t0 = time.perf_counter()
+        wire = lane.submit(job_body("lane"), tenant="bench")
+        assert lane.wait_idle(120.0), lane.health()
+        lane_s = time.perf_counter() - t0
+        final = lane.job_wire(wire["id"])
+        records = [
+            json.loads(l)
+            for l in lane.output_bytes(wire["id"]).decode().splitlines()
+        ]
+        assert final["status"] == "completed", final
+        assert len({r["id"] for r in records}) == items, "duplicate outputs"
+
+        # (b) Interactive latency with the lane quiet, then grinding.
+        lat_off = interactive()
+        lane.submit(job_body("grind"), tenant="bench")
+        lat_on = interactive()
+        assert lane.wait_idle(120.0), lane.health()
+        lane.drain(timeout=10.0)
+    finally:
+        lane.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+    p99_off = quantile(lat_off, 0.99)
+    p99_on = quantile(lat_on, 0.99)
+    return {
+        "items": items,
+        "max_in_flight": in_flight,
+        "service_time_ms": work_s * 1000.0,
+        "foreground_s": round(foreground_s, 3),
+        "lane_s": round(lane_s, 3),
+        "lane_speedup_x": round(foreground_s / max(lane_s, 1e-6), 2),
+        "outputs_exactly_once": len({r["id"] for r in records}) == items,
+        "interactive": {
+            "requests": interactive_n,
+            "lane_off": {
+                "p50_ms": round(quantile(lat_off, 0.50), 2),
+                "p99_ms": round(p99_off, 2),
+            },
+            "lane_on": {
+                "p50_ms": round(quantile(lat_on, 0.50), 2),
+                "p99_ms": round(p99_on, 2),
+            },
+            "p99_ratio_on_over_off": round(p99_on / max(p99_off, 1e-6), 2),
+        },
+    }
+
+
 def _emit(value, vs_baseline, detail: dict, error: "str | None" = None) -> None:
     line = {
         "metric": "n32_consensus_p50_over_single_p50",
@@ -1292,6 +1405,10 @@ def main() -> None:
         detail["tenancy"] = bench_tenancy()
     except Exception as exc:  # hermetic like quality; a failure here is a bug
         detail["tenancy"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    try:
+        detail["batch_lane"] = bench_batch_lane()
+    except Exception as exc:  # hermetic like quality; a failure here is a bug
+        detail["batch_lane"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     try:
         detail["serving"] = bench_serving()
     except Exception as exc:  # hermetic like quality; a failure here is a bug

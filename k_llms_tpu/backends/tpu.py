@@ -236,6 +236,16 @@ class BackendConfig(BaseModel):
     # the scheduler starts shedding batch-class admissions (also armed by
     # sustained OOM backoff, width_shift >= 2). See engine/scheduler.py.
     brownout_high_water: float = 0.9
+    # -- offline batch lane (serving/batch.py) --
+    # Durable root for the batch job store (journal + output segments);
+    # None → the serving app falls back to KLLMS_BATCH_DIR or an ephemeral
+    # tempdir (no restart recovery).
+    batch_store_dir: Optional[str] = None
+    # Bound on concurrently-executing batch items (worker threads feeding the
+    # scheduler at batch-SLO priority under the owner's quota).
+    batch_max_in_flight: int = 4
+    # Re-dispatches after a quota 429 before the item fails into the output.
+    batch_item_retries: int = 1
 
 
 def _detect_hbm_bytes() -> Optional[int]:

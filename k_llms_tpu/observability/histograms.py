@@ -132,6 +132,11 @@ class LatencyHistograms:
 #: labeled family per base name (``kllms_request_e2e_by_tenant_seconds``
 #: with a ``tenant`` label) so per-tenant SLO compliance is scrapeable
 #: without pre-registering tenant names.
+#: The batch-lane families (ISSUE 17): ``batch.item`` — one offline item's
+#: end-to-end wall time through the lane (dequeue → committed output
+#: segment); ``batch.job_e2e`` — a whole job from durable submission to
+#: terminal status, wall clock, spanning restarts (the journal carries
+#: ``created_at``).
 LATENCY = LatencyHistograms(declared=(
     "request.e2e",
     "request.ttft",
@@ -139,6 +144,8 @@ LATENCY = LatencyHistograms(declared=(
     "continuous.step",
     "engine.decode_launch",
     "consensus.consolidate",
+    "batch.item",
+    "batch.job_e2e",
     "request.e2e.*",
     "request.ttft.*",
     "scheduler.queue_wait.*",

@@ -93,6 +93,19 @@ Injection sites wired in this package:
                            named tenant so the typed 429 path — bucket-refill
                            ``retry_after``, per-tenant shed counters — is
                            exercisable without actually draining a bucket
+- ``batch.store``        — evaluated inside every batch job-store journal
+                           append (``reliability/jobstore.py``); the ``torn``
+                           action writes only a PREFIX of the CRC frame and
+                           then raises, leaving exactly the on-disk state a
+                           kill mid-append leaves, so torn-tail truncation on
+                           recovery is exercisable without killing a process
+- ``batch.worker``       — evaluated at the top of every batch-lane worker
+                           iteration, after an item is dequeued but BEFORE it
+                           is marked started (``serving/batch.py``); the
+                           ``crash`` action kills the worker thread itself so
+                           crash containment must checkpoint the dequeued
+                           item back to pending and the lane's exactly-once
+                           recovery must complete the job after restart
 
 Actions (``FailSpec.action``):
 
@@ -142,6 +155,10 @@ Actions (``FailSpec.action``):
                        buckets as empty for that request (typed 429 with the
                        bucket's own refill ``retry_after``), keyed by tenant
                        name like the replica sites
+- ``"torn"``         — the job store's journal append reads the spec, writes
+                       a partial frame (no fsync), and raises — a simulated
+                       power cut mid-write; recovery must truncate the torn
+                       tail and re-admit the affected items exactly once
 
 ``times`` bounds how often a spec fires (fail-rs' ``N*action``): after that
 many evaluations the site reverts to no-op — this is how "backend fails twice
@@ -163,9 +180,11 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="continuous.worker=crash:1"
     KLLMS_FAILPOINTS="serving.trace=drop:2"
     KLLMS_FAILPOINTS="scheduler.tenant=exhaust:bulk:2"
+    KLLMS_FAILPOINTS="batch.store=torn:1"
+    KLLMS_FAILPOINTS="batch.worker=crash:1"
 where the first numeric arg is ``times`` for
-raise/sleep/oom/corrupt/disconnect/fallback/drop/crash specs (crash defaults to
-firing once), ``times[:delay]`` for hang, ``kill[:seed]`` for
+raise/sleep/oom/corrupt/disconnect/fallback/drop/torn/crash specs (crash
+defaults to firing once), ``times[:delay]`` for hang, ``kill[:seed]`` for
 kill_samples/nan, ``kill`` (pages to drop) for leak, and ``member[:times]``
 for down/fail/exhaust (keyed sites: replica sites by replica id,
 ``scheduler.tenant`` by tenant name).
@@ -203,6 +222,8 @@ SITES = (
     "continuous.worker",
     "serving.trace",
     "scheduler.tenant",
+    "batch.store",
+    "batch.worker",
 )
 
 #: Default "hang" duration: long enough that a watchdog MUST intervene for the
@@ -225,7 +246,7 @@ def _injected_oom() -> BaseException:
 class FailSpec:
     # "raise" | "oom" | "sleep" | "hang" | "kill_samples" | "nan" | "corrupt"
     # | "down" | "fail" | "disconnect" | "leak" | "fallback" | "crash"
-    # | "drop" | "exhaust"
+    # | "drop" | "exhaust" | "torn"
     action: str = "raise"
     error_factory: Callable[[], BaseException] = field(
         default=lambda: RuntimeError("injected failpoint fault")
@@ -254,6 +275,7 @@ class FailSpec:
             "crash",
             "drop",
             "exhaust",
+            "torn",
         ):
             raise ValueError(f"unknown failpoint action {self.action!r}")
         if self.action == "hang" and self.delay <= 0:
@@ -298,7 +320,7 @@ def fire(site: str) -> Optional[FailSpec]:
     if spec.action in ("sleep", "hang"):
         time.sleep(spec.delay)
         return None
-    return spec  # kill_samples/nan/corrupt/disconnect: the site's owner interprets it
+    return spec  # kill_samples/nan/corrupt/disconnect/torn/...: the site's owner interprets it
 
 
 def fire_keyed(site: str, key: str) -> Optional[FailSpec]:
@@ -391,7 +413,7 @@ def configure_from_env(env: Optional[str] = None) -> None:
             times = int(args[0]) if args else 1
             delay = float(args[1]) if len(args) > 1 else HANG_DELAY
             specs[site] = FailSpec(action="hang", times=times, delay=delay)
-        elif action in ("oom", "corrupt", "disconnect", "fallback", "drop"):
+        elif action in ("oom", "corrupt", "disconnect", "fallback", "drop", "torn"):
             times = int(args[0]) if args else None
             specs[site] = FailSpec(action=action, times=times)
         elif action == "crash":

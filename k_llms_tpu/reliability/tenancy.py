@@ -29,7 +29,7 @@ slot admission); this module is pure bookkeeping with no thread of its own.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple
 
 from ..analysis.lockcheck import make_lock
@@ -41,6 +41,7 @@ __all__ = [
     "TenantContext",
     "TenancyConfig",
     "DEFAULT_TENANT",
+    "BATCH_LANE_SUFFIX",
 ]
 
 #: Recognized SLO classes, in strictly descending admission priority.
@@ -53,6 +54,12 @@ DEFAULT_TENANT = "default"
 #: to the default tenant so a credential-spraying client cannot grow the
 #: registry (or the /metrics label set) without bound.
 MAX_DYNAMIC_TENANTS = 1024
+
+#: Name suffix of a tenant's derived batch-lane context (ISSUE 17). ``#`` can
+#: never appear in an API-key-derived tenant name's configured form by
+#: accident of quoting — and even if a hostile key contains it, the lane view
+#: only ever SHARES the owner's buckets, so no quota is gained by collision.
+BATCH_LANE_SUFFIX = "#batch"
 
 
 class TokenBucket:
@@ -251,6 +258,21 @@ class TenantContext:
                 snap["row_tokens"] = round(self._row_bucket.level(), 3)
             return snap
 
+    @classmethod
+    def lane_view(cls, owner: "TenantContext", spec: TenantSpec) -> "TenantContext":
+        """A sibling context over the OWNER'S lock and buckets (ISSUE 17).
+
+        The offline batch lane runs under the owning tenant's quota but the
+        ``batch`` SLO class, and the scheduler keys its WFQ queues by context
+        name — so the lane needs a distinct name and spec while every quota
+        charge still lands atomically in the owner's token buckets."""
+        view = cls.__new__(cls)
+        view.spec = spec
+        view._lock = owner._lock
+        view._req_bucket = owner._req_bucket
+        view._row_bucket = owner._row_bucket
+        return view
+
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"TenantContext({self.spec.name!r}, slo={self.spec.slo!r})"
 
@@ -340,6 +362,11 @@ class TenancyConfig:
             ctx = self._contexts.get(name)
         if ctx is not None:
             return ctx
+        if name.endswith(BATCH_LANE_SUFFIX):
+            # A lane name round-tripped as a string (Completions.create's
+            # tenant= is a plain str): re-derive the shared-bucket view
+            # instead of materializing an unrelated dynamic tenant.
+            return self.batch_lane(name[: -len(BATCH_LANE_SUFFIX)] or None)
         spec = self.tenants.get(name)
         if spec is not None:
             return self._context(name, spec)
@@ -381,6 +408,26 @@ class TenancyConfig:
             if ctx is None:
                 ctx = TenantContext(spec, clock=self.clock)
                 self._contexts[name] = ctx
+            return ctx
+
+    def batch_lane(self, tenant: Any = None) -> TenantContext:
+        """The batch-SLO sibling of a tenant: ``<name>#batch`` (ISSUE 17).
+
+        Shares the owner's lock and token buckets (offline work draws down
+        the SAME quota as the owner's interactive traffic) but carries
+        ``slo="batch"`` under its own name, so the scheduler's WFQ keys it
+        as a separate, strictly-lower-priority queue. A tenant already in
+        the batch class IS its own lane."""
+        owner = self.resolve(tenant)
+        if owner.slo == "batch":
+            return owner
+        lane_name = owner.name + BATCH_LANE_SUFFIX
+        with self._lock:
+            ctx = self._contexts.get(lane_name)
+            if ctx is None:
+                spec = replace(owner.spec, name=lane_name, slo="batch")
+                ctx = TenantContext.lane_view(owner, spec)
+                self._contexts[lane_name] = ctx
             return ctx
 
     def known_tenants(self) -> Dict[str, TenantContext]:

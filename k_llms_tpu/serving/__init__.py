@@ -8,6 +8,7 @@ real sockets; ``python -m k_llms_tpu.serving`` starts it.
 """
 
 from .app import ServingApp, create_app
+from .batch import BatchLane
 from .server import HttpServer, ServerThread
 
-__all__ = ["ServingApp", "create_app", "HttpServer", "ServerThread"]
+__all__ = ["ServingApp", "create_app", "BatchLane", "HttpServer", "ServerThread"]

@@ -36,6 +36,12 @@ def _parse_args(argv=None) -> argparse.Namespace:
              "friendly admission; see engine/continuous.py)",
     )
     p.add_argument("--continuous-width", type=int, default=None)
+    p.add_argument(
+        "--batch-dir", default=None,
+        help="durable root for the offline batch lane's job store "
+             "(journal + outputs). Unfinished jobs found here resume at "
+             "startup; without it the lane uses an ephemeral tempdir.",
+    )
     p.add_argument("--log-level", default="info")
     return p.parse_args(argv)
 
@@ -53,7 +59,10 @@ async def _amain(args: argparse.Namespace) -> None:
             kwargs[key] = val
     if args.continuous_batching:
         kwargs["continuous_batching"] = True
-    app = create_app(**kwargs)
+    app = create_app(batch_dir=args.batch_dir, **kwargs)
+    # Restart recovery before the socket opens: journaled batch jobs resume
+    # whether or not the runner speaks the ASGI lifespan protocol.
+    await asyncio.to_thread(app.startup)
     server = HttpServer(app, host=args.host, port=args.port)
     await server.start()
 

@@ -14,6 +14,19 @@ Routes:
                                 then ONE final consensus ``chat.completion``
                                 event (consolidated choices[0] + likelihoods),
                                 then ``data: [DONE]``.
+    POST /v1/batches            durable offline batch submission: the body is
+                                a JSONL file of chat-completion requests
+                                (OpenAI batch lines or bare bodies). Journaled
+                                and fsynced BEFORE the 200 — a crash after the
+                                response can never lose the job. Items run at
+                                batch-SLO priority under the caller's quota.
+    GET  /v1/batches/{id}       job status + request counts.
+    POST /v1/batches/{id}/cancel
+                                cancel: queued items never run; in-flight
+                                items finish into the partial output.
+    GET  /v1/batches/{id}/output
+                                the output JSONL (one record per item, input
+                                order, exactly once). 409 until terminal.
     GET  /healthz               scheduler lifecycle snapshot; 200 while the
                                 backend admits work, 503 once DRAINING/STOPPED.
     GET  /metrics               Prometheus text exposition (0.0.4): HELP/TYPE
@@ -51,11 +64,13 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import tempfile
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..analysis.lockcheck import make_lock
 from ..observability import prometheus as _prom
 from ..reliability import failpoints as _failpoints
 from ..reliability.tenancy import permissive as _permissive_tenancy
@@ -96,7 +111,49 @@ _COUNTER_GROUPS = (
     ("kernel", "KERNEL_EVENTS"),
     ("grammar", "GRAMMAR_EVENTS"),
     ("tenant", "TENANT_EVENTS"),
+    ("batch", "BATCH_EVENTS"),
 )
+
+#: Declarative route table: (method, path pattern, handler attribute). Path
+#: segments in ``{braces}`` capture into the ``params`` dict every handler
+#: receives. Dispatch derives BOTH outcomes from this one table: unknown path
+#: → 404, known path with the wrong method → 405 + ``Allow`` (the methods
+#: listed here for that path) — so adding a route is one line, not a new
+#: elif arm plus hand-maintained error cases.
+_ROUTES: Tuple[Tuple[str, str, str], ...] = (
+    ("POST", "/v1/chat/completions", "_chat"),
+    ("POST", "/v1/batches", "_batch_create"),
+    ("GET", "/v1/batches/{batch_id}", "_batch_get"),
+    ("POST", "/v1/batches/{batch_id}/cancel", "_batch_cancel"),
+    ("GET", "/v1/batches/{batch_id}/output", "_batch_output"),
+    ("GET", "/healthz", "_healthz"),
+    ("GET", "/metrics", "_metrics"),
+    ("GET", "/debug/requests", "_debug_requests"),
+    ("POST", "/debug/profile", "_debug_profile"),
+)
+
+_COMPILED_ROUTES: Tuple[Tuple[str, Tuple[str, ...], str], ...] = tuple(
+    (method, tuple(pattern.strip("/").split("/")), handler)
+    for method, pattern, handler in _ROUTES
+)
+
+
+def _match_segments(
+    segments: Tuple[str, ...], parts: Tuple[str, ...]
+) -> Optional[Dict[str, str]]:
+    """Match one compiled pattern against a split request path; returns the
+    captured path params, or None when the path doesn't fit."""
+    if len(segments) != len(parts):
+        return None
+    params: Dict[str, str] = {}
+    for seg, part in zip(segments, parts):
+        if seg.startswith("{") and seg.endswith("}"):
+            if not part:
+                return None
+            params[seg[1:-1]] = part
+        elif seg != part:
+            return None
+    return params
 
 #: Upper bound for a POST /debug/profile capture; anything longer belongs in
 #: an offline KLLMS_PROFILE_DIR run, not a request handler.
@@ -106,8 +163,11 @@ _PROFILE_MAX_S = 10.0
 class ServingApp:
     """ASGI 3 application over one KLLMs client."""
 
-    def __init__(self, client: Any) -> None:
+    def __init__(self, client: Any, batch_dir: Optional[str] = None) -> None:
         self.client = client
+        self._batch_dir = batch_dir
+        self._batch: Optional[Any] = None  # BatchLane, built lazily
+        self._batch_lock = make_lock("serving.app_batch")
 
     # -- ASGI entry --------------------------------------------------------
     async def __call__(self, scope, receive, send) -> None:
@@ -117,17 +177,34 @@ class ServingApp:
         if scope["type"] != "http":  # pragma: no cover - websockets etc.
             return
         method, path = scope["method"], scope["path"]
+        parts = tuple(path.strip("/").split("/"))
+        matched: Optional[Tuple[str, Dict[str, str]]] = None
+        allowed: List[str] = []
+        for route_method, segments, handler in _COMPILED_ROUTES:
+            params = _match_segments(segments, parts)
+            if params is None:
+                continue
+            if route_method == method:
+                matched = (handler, params)
+                break
+            allowed.append(route_method)
         try:
-            if method == "POST" and path == "/v1/chat/completions":
-                await self._chat(scope, receive, send)
-            elif method == "GET" and path == "/healthz":
-                await self._healthz(send)
-            elif method == "GET" and path == "/metrics":
-                await self._metrics(send)
-            elif method == "GET" and path == "/debug/requests":
-                await self._debug_requests(send)
-            elif method == "POST" and path == "/debug/profile":
-                await self._debug_profile(receive, send)
+            if matched is not None:
+                handler, params = matched
+                await getattr(self, handler)(scope, receive, send, params)
+            elif allowed:
+                _obs.SERVE_EVENTS.record("request.unknown.405")
+                await _send_json(
+                    send, 405,
+                    _error_body(
+                        f"method {method} not allowed for {path}",
+                        "invalid_request_error", "method_not_allowed",
+                    ),
+                    extra_headers=[(
+                        b"allow",
+                        ", ".join(sorted(set(allowed))).encode(),
+                    )],
+                )
             else:
                 _obs.SERVE_EVENTS.record("request.unknown.404")
                 await _send_json(
@@ -150,25 +227,171 @@ class ServingApp:
         while True:
             message = await receive()
             if message["type"] == "lifespan.startup":
+                await asyncio.to_thread(self.startup)
                 await send({"type": "lifespan.startup.complete"})
             elif message["type"] == "lifespan.shutdown":
-                await asyncio.to_thread(self._drain)
+                await asyncio.to_thread(self.drain)
                 await send({"type": "lifespan.shutdown.complete"})
                 return
 
-    def _drain(self) -> None:
+    # -- lifecycle ---------------------------------------------------------
+    def startup(self) -> None:
+        """Eager restart recovery: when a DURABLE batch store is configured
+        (flag, config, or env — not an ephemeral tempdir), build the lane now
+        so journaled jobs resume without waiting for the first batch request.
+        Recovery failure degrades to lazy init; it never blocks serving."""
+        backend = getattr(self.client, "backend", None)
+        cfg = getattr(backend, "backend_config", None)
+        durable = (
+            self._batch_dir
+            or getattr(cfg, "batch_store_dir", None)
+            or os.environ.get("KLLMS_BATCH_DIR")
+        )
+        if not durable:
+            return
+        try:
+            self._batch_lane()
+        except Exception:
+            logger.exception("batch-lane startup recovery failed")
+
+    def drain(self) -> None:
+        """Graceful shutdown: checkpoint the batch lane FIRST (in-flight items
+        requeued durably), then drain the backend scheduler."""
+        with self._batch_lock:
+            lane = self._batch
+        if lane is not None:
+            lane.drain()
         backend = getattr(self.client, "backend", None)
         drain = getattr(backend, "drain", None)
         if callable(drain):
             drain()
 
+    def _batch_lane(self) -> Any:
+        """The lazily-built BatchLane (import deferred: batch.py imports this
+        module's _CREATE_KEYS at its top, so the reverse edge must be lazy)."""
+        with self._batch_lock:
+            if self._batch is None:
+                from ..reliability.jobstore import JobStore
+                from .batch import BatchLane
+
+                backend = getattr(self.client, "backend", None)
+                cfg = getattr(backend, "backend_config", None)
+                root = (
+                    self._batch_dir
+                    or getattr(cfg, "batch_store_dir", None)
+                    or os.environ.get("KLLMS_BATCH_DIR")
+                    or tempfile.mkdtemp(prefix="kllms-batches-")
+                )
+                lane = BatchLane(
+                    self.client,
+                    JobStore(root),
+                    max_in_flight=int(
+                        getattr(cfg, "batch_max_in_flight", 4) or 4
+                    ),
+                    item_retries=int(getattr(cfg, "batch_item_retries", 1) or 1),
+                )
+                lane.recover()
+                self._batch = lane
+            return self._batch
+
+    # -- /v1/batches -------------------------------------------------------
+    def _resolve_tenant(self, scope) -> str:
+        # Tenant resolution happens from the API key — never from the request
+        # body, so clients can't claim another tenant's quota or weight by
+        # naming it in JSON. Unmapped keys become their own dynamic tenant
+        # under the default spec (see TenancyConfig.tenant_for_key).
+        api_key: Optional[str] = None
+        for key, value in scope.get("headers") or []:
+            if key == b"authorization":
+                auth = value.decode("latin-1")
+                api_key = (
+                    auth[7:].strip()
+                    if auth[:7].lower() == "bearer " else auth.strip()
+                )
+        backend = getattr(self.client, "backend", None)
+        tenancy = getattr(backend, "tenancy", None) or _DEFAULT_TENANCY
+        return tenancy.tenant_for_key(api_key)
+
+    async def _batch_create(self, scope, receive, send, params) -> None:
+        tenant = self._resolve_tenant(scope)
+        body = await _read_body(receive)
+        try:
+            lane = await asyncio.to_thread(self._batch_lane)
+            wire = await asyncio.to_thread(lane.submit, body, tenant)
+        except Exception as e:
+            await self._send_error(send, e, route="batch")
+            return
+        _obs.SERVE_EVENTS.record("request.batch.200")
+        await _send_json(send, 200, wire)
+
+    async def _batch_get(self, scope, receive, send, params) -> None:
+        lane = await asyncio.to_thread(self._batch_lane)
+        wire = await asyncio.to_thread(lane.job_wire, params["batch_id"])
+        if wire is None:
+            await self._batch_404(send, params["batch_id"])
+            return
+        _obs.SERVE_EVENTS.record("request.batch.200")
+        await _send_json(send, 200, wire)
+
+    async def _batch_cancel(self, scope, receive, send, params) -> None:
+        await _read_body(receive)
+        lane = await asyncio.to_thread(self._batch_lane)
+        wire = await asyncio.to_thread(lane.cancel, params["batch_id"])
+        if wire is None:
+            await self._batch_404(send, params["batch_id"])
+            return
+        _obs.SERVE_EVENTS.record("request.batch.200")
+        await _send_json(send, 200, wire)
+
+    async def _batch_output(self, scope, receive, send, params) -> None:
+        lane = await asyncio.to_thread(self._batch_lane)
+        job_id = params["batch_id"]
+        if await asyncio.to_thread(lane.job_wire, job_id) is None:
+            await self._batch_404(send, job_id)
+            return
+        data = await asyncio.to_thread(lane.output_bytes, job_id)
+        if data is None:
+            # Known job, not terminal yet: 409 rather than a partial file —
+            # the output contract is "complete, input order, exactly once".
+            _obs.SERVE_EVENTS.record("request.batch.409")
+            await _send_json(
+                send, 409,
+                _error_body(
+                    f"batch {job_id} is not finished; output is available "
+                    "once the job reaches a terminal status",
+                    "invalid_request_error", "batch_not_finished",
+                ),
+            )
+            return
+        _obs.SERVE_EVENTS.record("request.batch.200")
+        await _send_bytes(
+            send, 200, data, content_type=b"application/jsonl"
+        )
+
+    async def _batch_404(self, send, job_id: str) -> None:
+        _obs.SERVE_EVENTS.record("request.batch.404")
+        await _send_json(
+            send, 404,
+            _error_body(
+                f"no batch job {job_id!r}",
+                "invalid_request_error", "not_found", param="batch_id",
+            ),
+        )
+
     # -- GET /healthz ------------------------------------------------------
-    async def _healthz(self, send) -> None:
+    async def _healthz(self, scope, receive, send, params) -> None:
         backend = getattr(self.client, "backend", None)
         health = getattr(backend, "health", None)
         snap = await asyncio.to_thread(health) if callable(health) else {
             "state": "ready"
         }
+        with self._batch_lock:
+            lane = self._batch
+        if lane is not None:
+            snap = dict(snap)
+            # Per-job progress rides the health snapshot so operators can
+            # watch offline work without polling every job id.
+            snap["batch"] = await asyncio.to_thread(lane.health)
         state = str(snap.get("state", "ready"))
         # Load-balancer semantics: 200 only while this replica ADMITS work.
         # DEGRADED still serves (at reduced width); RECOVERING/DRAINING/
@@ -178,7 +401,7 @@ class ServingApp:
         await _send_json(send, status, snap)
 
     # -- GET /metrics ------------------------------------------------------
-    async def _metrics(self, send) -> None:
+    async def _metrics(self, scope, receive, send, params) -> None:
         # Proper Prometheus 0.0.4 exposition: every family carries HELP/TYPE
         # lines, label values are escaped, and the latency histograms render
         # the full _bucket/_sum/_count triple (cumulative, +Inf included).
@@ -307,7 +530,7 @@ class ServingApp:
             _error_body("not found", "invalid_request_error", "not_found"),
         )
 
-    async def _debug_requests(self, send) -> None:
+    async def _debug_requests(self, scope, receive, send, params) -> None:
         if not self._debug_enabled():
             await self._debug_denied(send)
             return
@@ -318,7 +541,7 @@ class ServingApp:
             {"requests": recorder.snapshot(), **recorder.stats()},
         )
 
-    async def _debug_profile(self, receive, send) -> None:
+    async def _debug_profile(self, scope, receive, send, params) -> None:
         if not self._debug_enabled():
             await self._debug_denied(send)
             return
@@ -357,29 +580,16 @@ class ServingApp:
         )
 
     # -- POST /v1/chat/completions ----------------------------------------
-    async def _chat(self, scope, receive, send) -> None:
+    async def _chat(self, scope, receive, send, params) -> None:
         # Trace ownership lives at the front door: ingest the caller's W3C
         # context (or generate one), bind it for every downstream
         # await/to_thread of this request, and finish it — exactly once —
         # on whichever terminal path the request takes.
         traceparent = None
-        api_key: Optional[str] = None
         for key, value in scope.get("headers") or []:
             if key == b"traceparent":
                 traceparent = value.decode("latin-1")
-            elif key == b"authorization":
-                auth = value.decode("latin-1")
-                api_key = (
-                    auth[7:].strip()
-                    if auth[:7].lower() == "bearer " else auth.strip()
-                )
-        # Tenant resolution happens HERE, from the API key — never from the
-        # request body, so clients can't claim another tenant's quota or
-        # weight by naming it in JSON. Unmapped keys become their own dynamic
-        # tenant under the default spec (see TenancyConfig.tenant_for_key).
-        backend = getattr(self.client, "backend", None)
-        tenancy = getattr(backend, "tenancy", None) or _DEFAULT_TENANCY
-        tenant = tenancy.tenant_for_key(api_key)
+        tenant = self._resolve_tenant(scope)
         _obs.TENANT_EVENTS.record(f"tenant.requests.{tenant}")
         trace = _obs.TRACER.start(traceparent)
         outcome: Dict[str, Any] = {"status": 500, "n": None, "error": None}
@@ -645,14 +855,16 @@ class ServingApp:
 
 
 def create_app(
-    client: Optional[Any] = None, **client_kwargs: Any
+    client: Optional[Any] = None,
+    batch_dir: Optional[str] = None,
+    **client_kwargs: Any,
 ) -> ServingApp:
     """Build the app, constructing a KLLMs client when one isn't supplied."""
     if client is None:
         from ..client import KLLMs
 
         client = KLLMs(**client_kwargs)
-    return ServingApp(client)
+    return ServingApp(client, batch_dir=batch_dir)
 
 
 # -- ASGI plumbing ---------------------------------------------------------

@@ -27,7 +27,8 @@ _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 32 * 1024 * 1024
 
 _STATUS_PHRASES = {
-    200: "OK", 400: "Bad Request", 404: "Not Found", 408: "Request Timeout",
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 408: "Request Timeout", 409: "Conflict",
     429: "Too Many Requests", 499: "Client Closed Request",
     500: "Internal Server Error", 503: "Service Unavailable",
 }
@@ -50,15 +51,21 @@ class HttpServer:
         logger.info("serving on http://%s:%d", self.host, self.port)
 
     async def stop(self, drain: bool = True) -> None:
-        """Graceful shutdown: stop accepting, then drain the backend (typed
-        503s for late arrivals, in-flight work finishes)."""
+        """Graceful shutdown: stop accepting, then drain — preferring the
+        app's own drain() (batch-lane checkpoint THEN backend) and falling
+        back to the bare backend for non-ServingApp apps (typed 503s for late
+        arrivals, in-flight work finishes)."""
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
         if drain:
-            backend = getattr(getattr(self.app, "client", None), "backend", None)
-            drain_fn = getattr(backend, "drain", None)
+            drain_fn = getattr(self.app, "drain", None)
+            if not callable(drain_fn):
+                backend = getattr(
+                    getattr(self.app, "client", None), "backend", None
+                )
+                drain_fn = getattr(backend, "drain", None)
             if callable(drain_fn):
                 await asyncio.to_thread(drain_fn)
 

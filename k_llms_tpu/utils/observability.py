@@ -325,6 +325,36 @@ TENANT_EVENTS = EventCounters(declared=(
 ))
 
 
+#: Process-wide offline-batch-lane counters (ISSUE 17). Job lifecycle:
+#: ``batch.job_created`` — a POST /v1/batches submission journaled durably;
+#: ``batch.job_recovered`` — an unfinished job re-admitted from the journal
+#: after restart; ``batch.job_completed`` / ``batch.job_completed_with_errors``
+#: — terminal outcomes (a poisoned item fails alone, the job still finishes);
+#: ``batch.job_cancelled`` — explicit cancels. Item lifecycle:
+#: ``batch.item_completed`` / ``batch.item_failed`` — exactly-once output
+#: records committed (success vs typed-error capture);
+#: ``batch.item_requeued`` — in-flight items checkpointed back to pending by
+#: drain, a worker crash, or startup reconciliation. Durability drills:
+#: ``batch.worker_crashes`` — lane worker threads killed (the
+#: ``batch.worker=crash`` failpoint or a host bug); ``batch.store_torn_tail``
+#: — journal tails truncated on recovery (a kill mid-append, or the
+#: ``batch.store=torn`` failpoint). Fed by ``reliability/jobstore.py`` and
+#: ``serving/batch.py``; surfaced on ``/metrics`` as
+#: ``kllms_batch_events_total``.
+BATCH_EVENTS = EventCounters(declared=(
+    "batch.job_created",
+    "batch.job_recovered",
+    "batch.job_completed",
+    "batch.job_completed_with_errors",
+    "batch.job_cancelled",
+    "batch.item_completed",
+    "batch.item_failed",
+    "batch.item_requeued",
+    "batch.worker_crashes",
+    "batch.store_torn_tail",
+))
+
+
 def _walk_confidences(node: Any, out: List[float]) -> None:
     if isinstance(node, dict):
         for v in node.values():

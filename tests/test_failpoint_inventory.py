@@ -36,13 +36,15 @@ def test_ast_registry_matches_runtime_registry():
     assert reg is not None
     sites = FailpointCoverageRule()._sites(reg)
     assert set(sites) == set(SITES)
-    assert len(sites) >= 18
+    assert len(sites) >= 20
     assert "ops.paged_attn" in sites  # PR 11: paged-attention kernel drill
     assert "engine.grammar" in sites  # PR 12: constrained-decoding drill
     assert "continuous.step" in sites  # PR 13: decode-step hang drill
     assert "continuous.worker" in sites  # PR 13: worker-crash drill
     assert "serving.trace" in sites  # PR 14: tracer-degradation drill
     assert "scheduler.tenant" in sites  # PR 16: quota-exhaustion drill
+    assert "batch.store" in sites  # PR 17: torn journal-append drill
+    assert "batch.worker" in sites  # PR 17: batch-lane worker-crash drill
     for site in sites:
         sub, _, name = site.partition(".")
         assert sub and name, f"site {site!r} must be subsystem.name"
@@ -60,3 +62,4 @@ def test_action_whitelist_is_extracted():
     assert "crash" in actions  # PR 13: worker-thread kill drill
     assert "drop" in actions  # PR 14: tracer degrades to no-op spans
     assert "exhaust" in actions  # PR 16: tenant-bucket exhaustion drill
+    assert "torn" in actions  # PR 17: mid-append journal-tear drill

@@ -1,0 +1,301 @@
+"""JobStore durability: the exactly-once contract under kills at ANY point.
+
+Three layers of pinning:
+
+- Unit behavior: round-trip, reopen, requeue/late-commit convergence, cancel
+  with partial output, missing-input and stray-``.tmp`` reconciliation.
+- The ``batch.store`` failpoint's ``torn`` action: a prefix of a journal
+  frame reaches the file and the append raises — the exact disk state a kill
+  mid-write leaves. Recovery must truncate the tail and land the item on the
+  safe side (pending when "started" tore; done when the segment committed).
+- A byte-offset truncation sweep: replay a full job's journal, truncate a
+  COPY at every few bytes, reopen, and assert the invariants hold at every
+  single prefix — segments are authoritative, no duplicate output records,
+  no crash. This is the "kill anywhere" claim as an exhaustive loop rather
+  than a sampled race.
+"""
+
+import json
+import os
+import shutil
+from pathlib import Path
+
+import pytest
+
+from k_llms_tpu.reliability import failpoints as fp
+from k_llms_tpu.reliability.failpoints import FailSpec
+from k_llms_tpu.reliability.jobstore import JobStore, TERMINAL_STATUSES
+
+
+def _items(n):
+    return [
+        {
+            "custom_id": f"c{i}",
+            "rid": f"batch_req_{i:024d}",
+            "body": {
+                "messages": [{"role": "user", "content": f"q{i}"}],
+                "seed": i,
+            },
+        }
+        for i in range(n)
+    ]
+
+
+def _record(item, idx, error=False):
+    if error:
+        return {
+            "id": item["rid"], "custom_id": item["custom_id"],
+            "response": None,
+            "error": {
+                "status_code": 400, "message": "boom",
+                "type": "invalid_request_error", "param": None, "code": None,
+            },
+        }
+    return {
+        "id": item["rid"], "custom_id": item["custom_id"],
+        "response": {"status_code": 200, "body": {"idx": idx}},
+        "error": None,
+    }
+
+
+def _complete_job(store, items, job_id=None):
+    job = store.create_job(items, tenant="default", job_id=job_id)
+    for idx, item in enumerate(items):
+        assert store.note_item_started(job.id, idx)
+        assert store.commit_item(job.id, idx, _record(item, idx))
+    assert store.finish_job(job.id) == "completed"
+    return job.id
+
+
+def _output_ids(store, job_id):
+    out = store.read_output(job_id)
+    assert out is not None
+    return [json.loads(line)["id"] for line in out.splitlines()]
+
+
+def test_round_trip_and_reopen(tmp_path):
+    items = _items(4)
+    store = JobStore(tmp_path)
+    jid = _complete_job(store, items)
+    out = store.read_output(jid)
+    assert len(out.splitlines()) == 4
+    store.close()
+
+    store2 = JobStore(tmp_path)
+    job = store2.job(jid)
+    assert job.status == "completed"
+    assert job.counts() == {"total": 4, "completed": 4, "failed": 0}
+    assert store2.read_output(jid) == out
+    assert store2.unfinished_jobs() == []
+    store2.close()
+
+
+def test_error_items_complete_with_errors(tmp_path):
+    items = _items(3)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    for idx, item in enumerate(items):
+        store.note_item_started(job.id, idx)
+        store.commit_item(
+            job.id, idx, _record(item, idx, error=(idx == 1)), error=(idx == 1)
+        )
+    assert store.finish_job(job.id) == "completed_with_errors"
+    records = [
+        json.loads(line) for line in store.read_output(job.id).splitlines()
+    ]
+    assert [r["error"] is not None for r in records] == [False, True, False]
+    assert store.job(job.id).counts()["failed"] == 1
+    store.close()
+
+
+def test_torn_failpoint_on_started_append_rolls_back_to_pending(tmp_path):
+    """A torn 'started' record is invisible after recovery: the item is
+    pending again and executes normally."""
+    items = _items(2)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    with fp.failpoints({"batch.store": FailSpec(action="torn", times=1)}):
+        with pytest.raises(RuntimeError, match="torn journal append"):
+            store.note_item_started(job.id, 0)
+    store.close()
+
+    store2 = JobStore(tmp_path)
+    recovered = store2.job(job.id)
+    assert recovered.items == ["pending", "pending"]
+    # The torn tail is gone from disk: the journal replays cleanly now.
+    jid = job.id
+    for idx, item in enumerate(items):
+        assert store2.note_item_started(jid, idx)
+        assert store2.commit_item(jid, idx, _record(item, idx))
+    assert store2.finish_job(jid) == "completed"
+    assert len(_output_ids(store2, jid)) == 2
+    store2.close()
+
+
+def test_torn_failpoint_on_commit_append_segment_wins(tmp_path):
+    """Kill between segment rename and journal append: the segment is the
+    commit point, so recovery classifies the item done — exactly once."""
+    items = _items(2)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    store.note_item_started(job.id, 0)
+    with fp.failpoints({"batch.store": FailSpec(action="torn", times=1)}):
+        with pytest.raises(RuntimeError, match="batch.store"):
+            store.commit_item(job.id, 0, _record(items[0], 0))
+    store.close()
+
+    store2 = JobStore(tmp_path)
+    recovered = store2.job(job.id)
+    assert recovered.items[0] == "done"  # segment authoritative
+    assert recovered.items[1] == "pending"
+    store2.note_item_started(job.id, 1)
+    store2.commit_item(job.id, 1, _record(items[1], 1))
+    assert store2.finish_job(job.id) == "completed"
+    ids = _output_ids(store2, job.id)
+    assert len(ids) == 2 and len(set(ids)) == 2
+    store2.close()
+
+
+def test_manual_garbage_tail_truncated(tmp_path):
+    items = _items(2)
+    store = JobStore(tmp_path)
+    jid = _complete_job(store, items)
+    store.close()
+    journal = tmp_path / "journal.log"
+    intact = journal.read_bytes()
+    with open(journal, "ab") as fh:
+        fh.write(b"\x07garbage-partial-frame")
+    store2 = JobStore(tmp_path)
+    assert store2.job(jid).status == "completed"
+    assert journal.read_bytes() == intact  # tail truncated in place
+    store2.close()
+
+
+def test_kill_anywhere_truncation_sweep(tmp_path):
+    """Truncate a complete run's journal at every few byte offsets; every
+    prefix must recover to a consistent state with no duplicate outputs."""
+    src = tmp_path / "src"
+    src.mkdir()
+    items = _items(3)
+    store = JobStore(src)
+    jid = _complete_job(store, items, job_id="batch_sweep")
+    store.close()
+    journal_bytes = (src / "journal.log").read_bytes()
+
+    for cut in range(0, len(journal_bytes) + 1, 3):
+        trial = tmp_path / f"cut{cut}"
+        shutil.copytree(src, trial)
+        with open(trial / "journal.log", "ab") as fh:
+            fh.truncate(cut)
+        store2 = JobStore(trial)
+        jobs = store2.jobs()
+        if jid in jobs:
+            job = jobs[jid]
+            # Segments are authoritative: every committed segment must be
+            # reflected as done regardless of where the journal was cut.
+            for idx in range(job.n_items):
+                seg = trial / "jobs" / jid / "out" / f"{idx:05d}.json"
+                assert seg.exists(), "commit sweep wrote all segments"
+                assert job.items[idx] == "done", (cut, idx, job.items)
+            assert job.status == "completed"
+            ids = _output_ids(store2, jid)
+            assert len(ids) == 3 and len(set(ids)) == 3, (cut, ids)
+        # else: the cut removed the creation record itself — "job never
+        # submitted" is the other legal pole of the contract.
+        store2.close()
+        shutil.rmtree(trial)
+
+
+def test_requeue_then_late_commit_converges(tmp_path):
+    """Drain checkpoints an in-flight item to pending; the straggler thread
+    commits anyway. Both writers target the same segment with identical
+    bytes, so the output holds exactly one record."""
+    items = _items(1)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    store.note_item_started(job.id, 0)
+    assert store.requeue_item(job.id, 0)
+    assert store.job(job.id).items[0] == "pending"
+    # The straggler's late commit lands after the checkpoint:
+    assert store.commit_item(job.id, 0, _record(items[0], 0))
+    assert store.finish_job(job.id) == "completed"
+    assert len(_output_ids(store, job.id)) == 1
+    store.close()
+    # And the journal's pending->done sequence replays to the same state.
+    store2 = JobStore(tmp_path)
+    assert store2.job(job.id).status == "completed"
+    assert len(_output_ids(store2, job.id)) == 1
+    store2.close()
+
+
+def test_requeue_refuses_non_started(tmp_path):
+    items = _items(1)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    assert not store.requeue_item(job.id, 0)  # pending, not started
+    store.note_item_started(job.id, 0)
+    store.commit_item(job.id, 0, _record(items[0], 0))
+    assert not store.requeue_item(job.id, 0)  # done is final
+    store.close()
+
+
+def test_cancel_keeps_partial_output(tmp_path):
+    items = _items(3)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    store.note_item_started(job.id, 0)
+    store.commit_item(job.id, 0, _record(items[0], 0))
+    assert store.cancel_job(job.id) == "cancelled"
+    assert len(_output_ids(store, job.id)) == 1
+    # Cancelled is terminal: no new work may start, cancel is idempotent.
+    assert not store.note_item_started(job.id, 1)
+    assert store.cancel_job(job.id) == "cancelled"
+    store.close()
+    store2 = JobStore(tmp_path)
+    assert store2.job(job.id).status == "cancelled"
+    assert store2.unfinished_jobs() == []
+    store2.close()
+
+
+def test_stray_tmp_segment_discarded(tmp_path):
+    items = _items(1)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    store.close()
+    stray = tmp_path / "jobs" / job.id / "out" / "00000.json.tmp"
+    stray.write_bytes(b'{"half-written":')
+    store2 = JobStore(tmp_path)
+    assert not stray.exists()
+    assert store2.job(job.id).items == ["pending"]
+    store2.close()
+
+
+def test_unparsable_segment_reexecutes(tmp_path):
+    items = _items(1)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    store.note_item_started(job.id, 0)
+    store.close()
+    seg = tmp_path / "jobs" / job.id / "out" / "00000.json"
+    seg.write_bytes(b"\x00\xff not json")
+    store2 = JobStore(tmp_path)
+    assert not seg.exists()  # unlinked: re-execution is the safe direction
+    assert store2.job(job.id).items == ["pending"]
+    store2.close()
+
+
+def test_missing_input_marks_cancelled(tmp_path):
+    items = _items(1)
+    store = JobStore(tmp_path)
+    job = store.create_job(items, tenant="default")
+    store.close()
+    os.unlink(tmp_path / "jobs" / job.id / "input.jsonl")
+    store2 = JobStore(tmp_path)
+    assert store2.job(job.id).status == "cancelled"
+    store2.close()
+
+
+def test_terminal_statuses_are_frozen():
+    assert set(TERMINAL_STATUSES) == {
+        "completed", "completed_with_errors", "cancelled"
+    }
