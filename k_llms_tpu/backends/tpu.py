@@ -177,6 +177,16 @@ class BackendConfig(BaseModel):
     # the coalescing path.
     continuous_max_prompt: int = 512
     continuous_max_new: int = 256
+    # -- chunked prefill (PR 18) ------------------------------------------
+    # Prompts longer than this many tokens are ingested into the continuous
+    # loop chunk by chunk, one chunk interleaved between decode steps, so a
+    # long admission no longer stalls every in-flight row for a whole
+    # prefill. None = auto (HbmMemoryModel.prefill_chunk_tokens sizes a
+    # chunk at a small multiple of one decode step's row work); 0 = off —
+    # the whole-prompt admission path, byte-identical output either way
+    # (pinned by tests/test_chunked_prefill.py). Values are normalized down
+    # to a power of two >= 32 by the loop.
+    prefill_chunk_tokens: Optional[int] = None
     # -- paged KV cache (PR 7) --------------------------------------------
     # Paged layout for the continuous loop's KV: a fixed pool of fixed-size
     # pages with per-row block tables; an n-way fan-out's rows SHARE the
@@ -246,6 +256,9 @@ class BackendConfig(BaseModel):
     batch_max_in_flight: int = 4
     # Re-dispatches after a quota 429 before the item fails into the output.
     batch_item_retries: int = 1
+    # TTL for terminal batch jobs: on store open, jobs older than this are
+    # GC'd (journal gc record + directory removal). None/0 → keep forever.
+    jobstore_ttl_s: Optional[float] = None
 
 
 def _detect_hbm_bytes() -> Optional[int]:
@@ -346,6 +359,23 @@ class HbmMemoryModel:
         )
         rows = self.dp * max(0, self.budget_bytes()) // max(1, per_row)
         return max(1, int(rows))
+
+    def prefill_chunk_tokens(self, width: int, max_prompt: int) -> int:
+        """Auto chunk size for interleaved prefill. A decode step computes
+        one token-row per active slot (<= ``width``); a C-token chunk costs
+        ~C token-rows of the same per-layer work, so C ~= 4*width keeps the
+        chunk's step-budget share within a small multiple of a decode step
+        (the <= 3x steady-state stall bound bench_chunked_prefill pins).
+        Power of two, floored at 32, capped at max_prompt // 2 so chunking
+        actually splits any prompt it engages on; 0 (off) when the prompt
+        bound is too small for chunking to ever help."""
+        if max_prompt < 64:
+            return 0
+        target = min(max(32, 4 * max(1, int(width))), max_prompt // 2)
+        c = 32
+        while c * 2 <= target:
+            c *= 2
+        return c
 
     def describe(self) -> Dict[str, Any]:
         return {
@@ -612,6 +642,11 @@ class TpuBackend(Backend):
         # clamp envelope, independent learned state.
         from ..reliability.supervisor import LaunchBudgetModel
 
+        chunk = cfg.prefill_chunk_tokens
+        if chunk is None:
+            chunk = self.memory_model.prefill_chunk_tokens(
+                max(1, width), cfg.continuous_max_prompt
+            )
         return ContinuousDecodeLoop(
             self.engine,
             width=max(1, width),
@@ -631,6 +666,7 @@ class TpuBackend(Backend):
             on_recovering=self.scheduler.note_recovering,
             on_rebuilt=self.scheduler.note_rebuilt,
             on_rebuild_failed=self.scheduler.note_rebuild_failed,
+            prefill_chunk_tokens=max(0, int(chunk)),
         )
 
     # -- engine lifecycle --------------------------------------------------

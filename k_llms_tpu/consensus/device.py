@@ -3,7 +3,7 @@
 The host consensus engine (alignment.py / voting.py / primitive.py) is pure
 Python over one field pair or one vote column at a time — ~16 ms warm per n=32
 request (BENCH_r05 ``host_consensus``), serialized behind the GIL. This module
-ports the three hot kernels to batched, jittable JAX so the per-request
+ports the hot kernels to batched, jittable JAX so the per-request
 similarity and voting work runs as a handful of chip dispatches:
 
 - **Batched Levenshtein** (:func:`batched_levenshtein`): every unique string
@@ -11,6 +11,10 @@ similarity and voting work runs as a handful of chip dispatches:
   insertion chain (``new_row[i] = min(new_row[i-1]+1, ...)``) is solved as a
   min-plus prefix scan — ``cummin(d - idx) + idx`` — so each of the L scan
   steps is fully vectorized across pairs and row positions.
+- **Batched cosine similarity** (:func:`batched_cosine`): every
+  embedding-method pair of a consolidation scored in one padded ``[pairs, D]``
+  device reduction instead of a per-pair host numpy loop, grouped by embedding
+  dimensionality so jit compiles one shape per embed model.
 - **Batched majority vote** (:func:`batched_votes`): all enum-like aligned
   columns of a consolidation tallied in one ``[fields, samples, candidates]``
   one-hot reduction, including the canonical-spelling election (spelling
@@ -20,12 +24,16 @@ similarity and voting work runs as a handful of chip dispatches:
   ``lax.scan``, for chip deployments; the production host path keeps float64
   numpy here because f32 similarity re-derivation could flip threshold ties.
 
-Equivalence architecture (pinned by tests/test_device_consensus.py): kernels
-compute only **integers** — edit distances, tallies, winner indices. Every
-float the consensus pipeline consumes (similarities, confidences) is derived
-host-side in float64 by the *same expressions* the host path uses
+Equivalence architecture (pinned by tests/test_device_consensus.py): the
+alignment/vote kernels compute only **integers** — edit distances, tallies,
+winner indices. Every float those paths consume (similarities, confidences)
+is derived host-side in float64 by the *same expressions* the host path uses
 (``max(1e-8, 1 - dist/max_len)``, ``parent * count / total``), so device
-results are bit-identical to host results, not merely within tolerance.
+results are bit-identical to host results, not merely within tolerance. The
+one carve-out is the **batched cosine kernel** (:func:`batched_cosine`,
+ISSUE 18) for the embeddings method: its dot/norms run in device f32 against
+the host's float64, so its parity contract is tolerance-based (≤1e-5), with
+the zero-norm floor and [lower_bound, 1] clip mirrored exactly.
 Structure extraction and re-assembly stay on host: tree flatten → padded
 device arrays → align/vote on device → unflatten.
 
@@ -213,6 +221,77 @@ def batched_levenshtein(pairs: List[Tuple[str, str]]) -> List[int]:
             out = np.asarray(kern(a, alen, b, blen))
             for j, i in enumerate(chunk):
                 results[i] = int(out[j])
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Kernel 1b: batched cosine similarity over embedding pairs
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _cosine_kernel(dim: int):
+    """Jitted raw cosine over ``[P, dim]`` embedding pairs. Returns
+    ``(cos [P] f32, zero_norm [P] bool)``; the [-1,1] -> [0,1] normalization,
+    the zero-norm floor, and the [lower_bound, 1] clip are derived HOST-side
+    in float64 by the same expression the host path uses — so the special
+    cases stay exact and only the dot/norm itself is f32-vs-f64 tolerance
+    (the embeddings carve-out pinned in tests/test_device_consensus.py)."""
+    jax = _require_jax()
+    import jax.numpy as jnp
+
+    def kernel(a, b):
+        dot = jnp.sum(a * b, axis=-1)
+        norm = jnp.sqrt(jnp.sum(a * a, axis=-1)) * jnp.sqrt(jnp.sum(b * b, axis=-1))
+        return dot / jnp.where(norm == 0.0, 1.0, norm), norm == 0.0
+
+    return jax.jit(kernel)
+
+
+def batched_cosine(pairs: List[Tuple[Any, Any]]) -> List[float]:
+    """Cosine similarities for embedding-vector pairs, batched on device.
+
+    Pairs are grouped by embedding dimensionality (one compiled shape per
+    embed model) and chunked along the pair axis with pow2 padding, like
+    :func:`batched_levenshtein`. Mismatched shapes within a pair raise
+    ``ValueError`` exactly like the host ``cosine_similarity``. Padding rows
+    are all-zero (zero norm -> floored) and discarded.
+    """
+    results = [0.0] * len(pairs)
+    by_dim: Dict[int, List[int]] = {}
+    mats: Dict[int, Tuple[List[Any], List[Any]]] = {}
+    for i, (e1, e2) in enumerate(pairs):
+        a1 = np.asarray(e1, dtype=np.float32)
+        a2 = np.asarray(e2, dtype=np.float32)
+        if a1.shape != a2.shape:
+            raise ValueError("Vectors must have the same shape for cosine similarity")
+        by_dim.setdefault(a1.size, []).append(i)
+        rows = mats.setdefault(a1.size, ([], []))
+        rows[0].append(a1.reshape(-1))
+        rows[1].append(a2.reshape(-1))
+    for dim, idxs in by_dim.items():
+        kern = _cosine_kernel(dim)
+        rows_a, rows_b = mats[dim]
+        for start in range(0, len(idxs), _PAIR_CHUNK):
+            chunk = idxs[start : start + _PAIR_CHUNK]
+            P = _pow2_bucket(len(chunk), _PAIR_MIN_BUCKET, _PAIR_CHUNK)
+            a = np.zeros((P, dim), dtype=np.float32)
+            b = np.zeros((P, dim), dtype=np.float32)
+            for j in range(len(chunk)):
+                a[j] = rows_a[start + j]
+                b[j] = rows_b[start + j]
+            cos, zero = kern(a, b)
+            cos = np.asarray(cos, dtype=np.float64)
+            zero = np.asarray(zero)
+            for j, i in enumerate(chunk):
+                if zero[j]:
+                    results[i] = SIMILARITY_SCORE_LOWER_BOUND
+                else:
+                    results[i] = float(
+                        np.clip(
+                            0.5 * (cos[j] + 1.0), SIMILARITY_SCORE_LOWER_BOUND, 1.0
+                        )
+                    )
     return results
 
 
@@ -547,15 +626,34 @@ class DeviceSimilarityScorer(SimilarityScorer):
 
     def _score_bucket(self, unique: List[str]) -> Dict[Tuple[str, str], float]:
         """Score every unordered pair of a bucket, routing Levenshtein work to
-        the device and keeping float derivation bit-identical to the host."""
+        the device (float derivation bit-identical to the host) and embedding
+        pairs to the batched cosine kernel (tolerance-equivalent; the one
+        float-producing kernel)."""
         pair_map: Dict[Tuple[str, str], float] = {}
         lev_jobs: List[Tuple[Tuple[str, str], str, str, int]] = []
+        cos_jobs: List[Tuple[Tuple[str, str], Any, Any]] = []
         host_pairs = 0
         for i, s1 in enumerate(unique):
             for s2 in unique[i + 1 :]:
                 key = (s1, s2) if s1 <= s2 else (s2, s1)
                 if key in pair_map:
                     continue
+                if (
+                    self.method == "embeddings"
+                    and len(s1) > EMBEDDING_MIN_CHARS
+                    and len(s2) > EMBEDDING_MIN_CHARS
+                    and self.embed_fn is not None
+                ):
+                    try:
+                        cos_jobs.append(
+                            (key, self.get_embedding(s1), self.get_embedding(s2))
+                        )
+                        continue
+                    except Exception as e:  # degrade to Levenshtein, like host
+                        logger.error(
+                            "Error getting embeddings for %r and %r", s1, s2,
+                            exc_info=e,
+                        )
                 sim = self._score_host_only(s1, s2)
                 if sim is not None:
                     pair_map[key] = sim
@@ -576,27 +674,22 @@ class DeviceSimilarityScorer(SimilarityScorer):
             dists = self._lev_distances([(n1, n2) for _, n1, n2, _ in lev_jobs])
             for (key, _, _, max_len), dist in zip(lev_jobs, dists):
                 pair_map[key] = max(SIMILARITY_SCORE_LOWER_BOUND, 1 - (dist / max_len))
+        if cos_jobs:
+            sims = self._cosine_sims([(e1, e2) for _, e1, e2 in cos_jobs])
+            for (key, _, _), sim in zip(cos_jobs, sims):
+                pair_map[key] = sim
         if host_pairs:
             CONSENSUS_EVENTS.record("consensus.host_pairs", host_pairs)
         return pair_map
 
     def _score_host_only(self, s1: str, s2: str) -> Optional[float]:
         """Methods the device doesn't kernelize, computed here so the bucket
-        cache still memoizes them. Returns None for the Levenshtein route."""
+        cache still memoizes them. Returns None for the Levenshtein route
+        (embedding-eligible pairs are batched by the caller first)."""
         if self.method == "jaccard":
             return jaccard_similarity(s1, s2)
         if self.method == "hamming":
             return hamming_similarity(s1, s2)
-        if (
-            self.method == "embeddings"
-            and len(s1) > EMBEDDING_MIN_CHARS
-            and len(s2) > EMBEDDING_MIN_CHARS
-            and self.embed_fn is not None
-        ):
-            try:
-                return cosine_similarity(self.get_embedding(s1), self.get_embedding(s2))
-            except Exception as e:  # degrade to Levenshtein, like string()
-                logger.error("Error getting embeddings for %r and %r", s1, s2, exc_info=e)
         return None
 
     def _lev_distances(self, pairs: List[Tuple[str, str]]) -> List[int]:
@@ -613,6 +706,21 @@ class DeviceSimilarityScorer(SimilarityScorer):
         CONSENSUS_EVENTS.record("consensus.device_busy")
         CONSENSUS_EVENTS.record("consensus.host_pairs", len(pairs))
         return [levenshtein_distance(a, b) for a, b in pairs]
+
+    def _cosine_sims(self, pairs: List[Tuple[Any, Any]]) -> List[float]:
+        """Batched device cosine; host float64 when the chip lock is busy —
+        same gate discipline as :meth:`_lev_distances`."""
+        if self._device_lock.acquire(blocking=False):
+            try:
+                note_device_dispatch("consensus cosine kernel")
+                sims = batched_cosine(pairs)
+                CONSENSUS_EVENTS.record("consensus.device_cosine", len(pairs))
+                return sims
+            finally:
+                self._device_lock.release()
+        CONSENSUS_EVENTS.record("consensus.device_busy")
+        CONSENSUS_EVENTS.record("consensus.host_pairs", len(pairs))
+        return [cosine_similarity(e1, e2) for e1, e2 in pairs]
 
     def _prefill_votes(self, contents: List[Any], consensus_settings: Any) -> None:
         """Batch-tally every vote-eligible aligned column into the vote memo,

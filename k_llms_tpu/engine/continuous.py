@@ -141,6 +141,12 @@ class _SlotRequest:
     # re-delivered.
     delivered_watermark: int = 0
     replays: int = 0
+    # Chunked-prefill cursor (journal observability): how many prompt tokens
+    # the PREFILLING phase has ingested so far. Replay after a rebuild resets
+    # it to 0 and re-prefills from scratch — the staging KV dies with the
+    # torn-down engine, and deterministic prefill + the submission-pinned
+    # seed make the replayed output byte-identical anyway.
+    chunk_cursor: int = 0
     # Request trace captured on the SUBMITTING thread (the loop worker does
     # not inherit contextvars), plus the enqueue timestamp for the
     # queue-wait span/histogram. Both are host-side observability only.
@@ -149,6 +155,34 @@ class _SlotRequest:
     # Resolved TenantContext (or None for the implicit default tenant):
     # drives WFQ slot selection and per-tenant queue-wait attribution.
     tenant: Optional[Any] = None
+
+
+class _Prefilling:
+    """The loop's single PREFILLING admission: a request whose prompt is
+    being ingested chunk by chunk between decode steps instead of in one
+    blocking prefill. Owns its slot rows (popped from ``_free`` but NOT in
+    ``_active`` — the decode step must never see a half-prefilled row), the
+    1-row staging KV the chunks extend, and, in paged mode, the prompt page
+    run (n row references) plus each row's pre-reserved generation pages.
+    All fields are guarded by the loop lock; the dispatch closure only reads
+    snapshots taken under it."""
+
+    __slots__ = ("req", "rows", "ids", "cache", "cursor", "plen", "bucket",
+                 "run_pages", "reserved")
+
+    def __init__(self, req: "_SlotRequest", rows: List[int], ids: List[int],
+                 cache: Any, plen: int, bucket: int,
+                 run_pages: Optional[List[int]],
+                 reserved: List[List[int]]) -> None:
+        self.req = req
+        self.rows = rows
+        self.ids = ids
+        self.cache = cache
+        self.cursor = 0
+        self.plen = plen
+        self.bucket = bucket
+        self.run_pages = run_pages
+        self.reserved = reserved
 
 
 def _req_tenant_name(req: "_SlotRequest") -> str:
@@ -280,6 +314,7 @@ class ContinuousDecodeLoop:
         on_recovering: Optional[Callable[[int, str], None]] = None,
         on_rebuilt: Optional[Callable[[], None]] = None,
         on_rebuild_failed: Optional[Callable[[BaseException], None]] = None,
+        prefill_chunk_tokens: int = 0,
     ) -> None:
         # Only the worker swaps in an epoch-fenced replacement during
         # recovery; readers tolerate either generation, and admission
@@ -288,9 +323,10 @@ class ContinuousDecodeLoop:
         self.engine = engine
         # Runtime twin of the annotations in this __init__ plus the
         # qualifies() inline suppression: the lockset sanitizer skips what the
-        # static rule skips. The device-state family (_prefix/_gen/_step_fn
-        # and the paged twins) is handed to the disposable dispatch thread
-        # under the epoch fence rather than the loop lock.
+        # static rule skips. The device-state family (_prefix/_gen/_step_fn,
+        # the paged twins, and the resolved _paged_attn_impl) is handed to
+        # the disposable dispatch thread under the epoch fence rather than
+        # the loop lock.
         race_exempt(
             self,
             "engine",
@@ -302,11 +338,29 @@ class ContinuousDecodeLoop:
             "_step_paged_fn",
             "_write_prefix_fn",
             "_sample_rows_fn",
+            "_paged_attn_impl",
             "_pool",
         )
         self.width = int(width)
         self.max_prompt = int(max_prompt)
         self.max_new = int(max_new)
+        # Chunked prefill (ISSUE 18): prompts longer than this many tokens
+        # are ingested chunk by chunk between decode steps instead of one
+        # blocking whole-prompt prefill. 0 = off (the whole-prompt path,
+        # byte-identical by the differential in tests/test_chunked_prefill.py).
+        # Normalized DOWN to a power of two >= 32: the prompt bucket is a
+        # power of two >= any prompt that chunks (plen > C), so a pow2 C
+        # always divides it and the paged chunk's fixed-width KV-column slice
+        # (cursor + C <= bucket) can never clamp out of range.
+        c = max(0, int(prefill_chunk_tokens))
+        if 0 < c < 32:
+            c = 32
+        elif c > 32:
+            c = 1 << (c.bit_length() - 1)
+        self.prefill_chunk_tokens = c
+        # The single in-flight chunked admission (at most one PREFILLING
+        # request at a time — one chunk rides alongside each decode step).
+        self._prefilling: Optional[_Prefilling] = None
         self.eos_ids = list(eos_ids or [engine.config.eos_token_id])
         self._admission_gate = admission_gate
         # Self-healing wiring (all optional — a bare loop without a budget
@@ -419,6 +473,10 @@ class ContinuousDecodeLoop:
             "restarts": 0,
             "replayed_rows": 0,
             "quarantined_rows": 0,
+            # Chunked prefill: total chunks run, and how many of them ran
+            # with decode rows in flight (the interleaving the feature buys).
+            "prefill_chunks": 0,
+            "prefill_interleaved": 0,
         }
         self._thread: Optional[threading.Thread] = None
 
@@ -600,7 +658,11 @@ class ContinuousDecodeLoop:
         with self._lock:
             self._closing = True
             self._lock.notify_all()
-            while self._queue or any(r is not None for r in self._active):
+            while (
+                self._queue
+                or self._prefilling is not None
+                or any(r is not None for r in self._active)
+            ):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -614,6 +676,11 @@ class ContinuousDecodeLoop:
             self._stopped = True
             pending = list(self._queue)
             self._queue.clear()
+            if self._prefilling is not None:
+                # A PREFILLING admission has delivered nothing yet — fail it
+                # like queued work (its pages die with the stopped loop).
+                pending.append(self._prefilling.req)
+                self._prefilling = None
             self._lock.notify_all()
         for req in pending:
             if not req.future.done():
@@ -771,6 +838,10 @@ class ContinuousDecodeLoop:
             if r is not None and r.grammar is not None \
                     and r.grammar.digest != grammar.digest:
                 return True
+        pf = self._prefilling
+        if pf is not None and pf.req.grammar is not None \
+                and pf.req.grammar.digest != grammar.digest:
+            return True
         return any(
             r.grammar is not None and r.grammar.digest != grammar.digest
             for r in self._queue
@@ -933,8 +1004,9 @@ class ContinuousDecodeLoop:
                 if self._pool_fault is not None:
                     raise _PoolFault(self._pool_fault)
                 self._admit_locked()
-                has_work = self._active_mask.any()
-                if not has_work:
+                has_decode = bool(self._active_mask.any())
+                prefilling = self._prefilling is not None
+                if not has_decode and not prefilling:
                     if self._closing and not self._queue:
                         self._lock.notify_all()
                         return
@@ -943,7 +1015,14 @@ class ContinuousDecodeLoop:
                     self._lock.wait(timeout=0.05)
                     self._shed_expired_locked()
                     continue
-            self._step_once()
+            # The interleave: one decode step for the active batch, then one
+            # prompt chunk for the (at most one) PREFILLING admission — a
+            # long prompt's ingestion is spread across decode steps instead
+            # of stalling every in-flight row for a whole prefill.
+            if has_decode:
+                self._step_once()
+            if prefilling:
+                self._prefill_chunk_once()
 
     # -- recovery ----------------------------------------------------------
 
@@ -1044,6 +1123,14 @@ class ContinuousDecodeLoop:
         for r in self._active:
             if r is not None and id(r) not in seen and not r.future.done():
                 seen[id(r)] = r
+        # A half-prefilled admission survives too: its staging KV dies with
+        # the engine, so replay re-prefills from the journaled prompt ids
+        # (cursor back to 0) — deterministic prefill plus the submission-
+        # pinned seed make the replayed stream byte-identical regardless of
+        # where the chunk cursor stood at the fault.
+        pf = self._prefilling
+        if pf is not None and id(pf.req) not in seen and not pf.req.future.done():
+            seen[id(pf.req)] = pf.req
         survivors = sorted(seen.values(), key=lambda r: r.seq)
         for req in survivors:
             req.delivered_watermark = max(
@@ -1057,6 +1144,7 @@ class ContinuousDecodeLoop:
             req.done = []
             req.finish = []
             req.sample_errors = []
+            req.chunk_cursor = 0
         return survivors
 
     def _reset_device_state_locked(self) -> None:
@@ -1093,6 +1181,9 @@ class ContinuousDecodeLoop:
         self._prefix_idx[:] = 0
         self._gen_idx[:] = 0
         self._pool_fault = None
+        # Like the tables above: the holder's page references die with the
+        # pool, no decref against a replaced allocator.
+        self._prefilling = None
         self._built = False
 
     def adopt_engine(self, new_engine: Any) -> None:
@@ -1100,8 +1191,10 @@ class ContinuousDecodeLoop:
         rebuild path). With work in flight the worker journals, swaps, and
         replays on its own thread; an idle loop swaps inline."""
         with self._lock:
-            has_work = bool(self._queue) or any(
-                r is not None for r in self._active
+            has_work = (
+                bool(self._queue)
+                or self._prefilling is not None
+                or any(r is not None for r in self._active)
             )
             if not has_work:
                 self._loop_epoch += 1
@@ -1158,6 +1251,12 @@ class ContinuousDecodeLoop:
             if idx is None or len(self._free) < self._queue[idx].n:
                 break
             req = self._queue[idx]
+            chunked = self._chunk_eligible(req)
+            if chunked and self._prefilling is not None:
+                # One chunked admission at a time: the head waits for the
+                # in-flight PREFILLING to finish (no skipping past it — the
+                # same no-starvation rule as the slot-shortage break above).
+                break
             del self._queue[idx]
             if req.budget is not None and req.budget.should_abort():
                 FAILURE_EVENTS.record("scheduler.shed")
@@ -1179,11 +1278,18 @@ class ContinuousDecodeLoop:
             req.slots = rows
             try:
                 _admit_t0 = time.perf_counter()
-                self._admit_device(req, rows)
-                if req.trace is not None:
-                    req.trace.add_phase(
-                        "prefill", time.perf_counter() - _admit_t0
-                    )
+                if chunked:
+                    # Enter the PREFILLING state instead of prefilling here:
+                    # the worker runs one chunk per loop iteration alongside
+                    # the decode batch (per-chunk prefill trace spans are
+                    # recorded by _prefill_chunk_once, not here).
+                    self._begin_prefilling_locked(req, rows)
+                else:
+                    self._admit_device(req, rows)
+                    if req.trace is not None:
+                        req.trace.add_phase(
+                            "prefill", time.perf_counter() - _admit_t0
+                        )
             except PagePoolExhausted as e:
                 # Pages are a transient resource: in-flight rows free theirs
                 # as they retire, so park the head request and retry after the
@@ -1231,8 +1337,6 @@ class ContinuousDecodeLoop:
 
     def _admit_device(self, req, rows) -> None:
         engine = self.engine
-        prompt_len = req.prompt_len
-        seed, temperature, top_p = req.seed, req.temperature, req.top_p
         _ids, _plen, bucket = engine._prep_prompt(req.ids)
         n = len(rows)
         if self.paged:
@@ -1250,7 +1354,17 @@ class ContinuousDecodeLoop:
             self._prefix = self._write_prefix_fn(
                 self._prefix, rep_k, rep_v, rows_arr
             )
+        self._admit_rows(req, rows, first_logits)
 
+    def _admit_rows(self, req, rows, first_logits) -> None:
+        """The layout-independent admission tail, shared by whole-prompt
+        admission and the chunked-prefill finish: sample each row's first
+        token from the prefill logits with the submission-pinned seed at
+        step 0 (so chunked-on/off token streams are byte-identical), install
+        the slot mirrors, and run first-step retirement/delivery."""
+        prompt_len = req.prompt_len
+        seed, temperature, top_p = req.seed, req.temperature, req.top_p
+        n = len(rows)
         # First-token sampling at admission (step 0), padded to W rows.
         W = self.width
         V = first_logits.shape[-1]
@@ -1342,6 +1456,264 @@ class ContinuousDecodeLoop:
         self._stats["quarantined_rows"] += 1
         if req.trace is not None:
             req.trace.bump("quarantined_rows")
+
+    # -- chunked prefill (ISSUE 18) ---------------------------------------
+
+    def _chunk_eligible(self, req: _SlotRequest) -> bool:
+        """Should this admission take the PREFILLING path? Only prompts
+        longer than one chunk, and only when the prefix cache cannot supply
+        the prompt anyway — exact and usable partial hits skip straight to
+        DECODING through the (cheap) whole-prompt path. Called with the loop
+        lock held; the probe takes the engine's paged mutex internally."""
+        C = self.prefill_chunk_tokens
+        if C <= 0 or req.prompt_len <= C:
+            return False
+        probe = getattr(self.engine, "prefix_cached_len", None)
+        return probe is None or probe(req.ids) == 0
+
+    def _begin_prefilling_locked(self, req: _SlotRequest, rows: List[int]) -> None:
+        """Enter the PREFILLING state: allocate the prompt's page run and
+        every row's generation reserve UP FRONT (chunk-aware reservation —
+        the same worst-case demand qualifies() checked, so a half-prefilled
+        admission can never strand mid-prompt on allocation), build the
+        1-row staging KV the chunks extend, and hand the request to the
+        worker's chunk phase. Raises :class:`PagePoolExhausted` with
+        everything rolled back, exactly like whole-prompt admission."""
+        engine = self.engine
+        _ids, _plen, bucket = engine._prep_prompt(req.ids)
+        run_pages: Optional[List[int]] = None
+        reserved: List[List[int]] = []
+        if self.paged:
+            alloc = self._pool.allocator
+            ps = self._pool.page_size
+            reserve = (_plen + req.max_new - 1) // ps - _plen // ps + 1
+            with engine._paged_mutex:
+                run_pages = engine._alloc_pages_with_evict(pages_for(_plen, ps))
+                extra_refs = 0
+                try:
+                    # One prompt-run reference per row (the n-way fan-out
+                    # shares one copy, like _admit_paged_kv).
+                    for _ in range(len(rows) - 1):
+                        alloc.incref(run_pages)
+                        extra_refs += 1
+                    for _ in rows:
+                        reserved.append(engine._alloc_pages_with_evict(reserve))
+                except BaseException:
+                    for lst in reserved:
+                        alloc.decref(lst)
+                    for _ in range(extra_refs + 1):
+                        alloc.decref(run_pages)
+                    raise
+        cache = init_cache(engine.config, 1, bucket)
+        mesh = getattr(engine, "mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..parallel.sharding import cache_specs
+
+            cache = jax.device_put(
+                cache,
+                KVCache(
+                    k=NamedSharding(mesh, cache_specs(shared_prefix=True)),
+                    v=NamedSharding(mesh, cache_specs(shared_prefix=True)),
+                ),
+            )
+        req.chunk_cursor = 0
+        self._prefilling = _Prefilling(
+            req, list(rows), list(_ids), cache, _plen, bucket,
+            run_pages, reserved,
+        )
+
+    def _prefill_chunk_once(self) -> None:
+        """Run ONE prompt chunk for the PREFILLING admission (worker thread,
+        between decode steps). The chunk is dispatched under the same
+        watchdog/epoch-fence discipline as a decode step — a hung chunk
+        abandons its thread and rebuilds, and the journal replays the
+        admission from cursor 0. The final chunk's logits feed the shared
+        first-token admission tail, so the sampled stream is byte-identical
+        to whole-prompt prefill."""
+        with self._lock:
+            pf = self._prefilling
+            if pf is None:
+                return
+            req = pf.req
+            if req.budget is not None and req.budget.should_abort():
+                # Budget abort retires the PREFILLING row through the same
+                # fault counters as a decoding abort.
+                self._retire_prefilling_locked(
+                    req.budget.error("engine prefill"), abort=True
+                )
+                return
+            epoch = self._loop_epoch
+            C = self.prefill_chunk_tokens
+            start = pf.cursor
+            end = min(start + C, pf.plen)
+            valid = end - start
+            final = end >= pf.plen
+            pad_id = self.engine.config.pad_token_id
+            chunk = np.full((1, C), pad_id, np.int32)
+            chunk[0, :valid] = pf.ids[start:end]
+            cache, bucket = pf.cache, pf.bucket
+            pool = slot_idx = None
+            if self.paged:
+                pool = self._pool
+                ps = pool.page_size
+                # The chunk's KV columns land in the row's reserved page run
+                # at its current offset; pad positions retarget to trash.
+                slot_idx = flat_slots(pf.run_pages, start + np.arange(C), ps)
+                trash = (np.arange(C) % ps + TRASH_PAGE * ps).astype(np.int32)
+                slot_idx[valid:] = trash[valid:]
+        fn = self.engine._get_prefill_chunk(C, bucket, self.paged)
+
+        def _dispatch():
+            # Hang-injection point for the chunk itself
+            # (``continuous.prefill``): fire() sleeps inline, so a ``hang``
+            # spec wedges THIS disposable thread under the watchdog budget —
+            # the mid-chunk twin of ``continuous.step``.
+            _failpoints.fire("continuous.prefill")
+            if self._loop_epoch != epoch:
+                raise _StaleStep("prefill chunk fenced before dispatch")
+            note_device_dispatch("continuous prefill chunk")
+            if self.paged:
+                logits, new_cache, k_cols, v_cols = fn(
+                    self.engine.params, jnp.asarray(chunk), cache,
+                    jnp.int32(start), jnp.int32(valid),
+                )
+                if self._loop_epoch != epoch:
+                    raise _StaleStep("prefill chunk fenced post-dispatch")
+                pool.scatter_tokens(k_cols, v_cols, slot_idx)
+            else:
+                logits, new_cache = fn(
+                    self.engine.params, jnp.asarray(chunk), cache,
+                    jnp.int32(start), jnp.int32(valid),
+                )
+                if self._loop_epoch != epoch:
+                    raise _StaleStep("prefill chunk fenced post-dispatch")
+            # Synchronize on the (tiny) logits readback so the watchdog
+            # budget covers the device work, like the step's readback.
+            # kllms: ignore[host-sync-hot-path] — the per-chunk completion sync; the cache stays on device
+            jax.device_get(logits)
+            return logits, new_cache
+
+        _chunk_t0 = time.perf_counter()
+        if self.budget_model is not None:
+            try:
+                first_logits, new_cache = self._dispatcher.run(
+                    _dispatch, self.budget_model.step_budget()
+                )
+            except _StepHung:
+                with self._lock:
+                    self._loop_epoch += 1
+                RECOVERY_EVENTS.record("continuous.step_hangs")
+                logger.error(
+                    "continuous prefill chunk overran its watchdog budget; "
+                    "abandoning the dispatch thread and rebuilding"
+                )
+                raise
+            # Deliberately NOT fed to observe_step: a C-token chunk would
+            # pollute the decode loop's per-step EWMA.
+        else:
+            first_logits, new_cache = _dispatch()
+        chunk_s = time.perf_counter() - _chunk_t0
+        LATENCY.observe("continuous.prefill_chunk", chunk_s)
+        with self._lock:
+            if self._loop_epoch != epoch or self._prefilling is not pf:
+                return
+            pf.cache = new_cache
+            pf.cursor = end
+            req.chunk_cursor = end
+            self._stats["prefill_chunks"] += 1
+            if self._active_mask.any():
+                self._stats["prefill_interleaved"] += 1
+            # A completed chunk is proof of life, like a completed step.
+            self._consecutive_faults = 0
+            if req.trace is not None:
+                # One add_phase per chunk: the prefill phase accumulates the
+                # total AND records a per-chunk span.
+                req.trace.add_phase("prefill", chunk_s)
+            if final:
+                self._prefilling = None
+                self._finish_prefilling_locked(pf, first_logits)
+                self._lock.notify_all()
+
+    def _finish_prefilling_locked(self, pf: _Prefilling, first_logits) -> None:
+        """Transition PREFILLING -> DECODING (lock held): install the fully
+        ingested prompt KV as the rows' prefix (block tables in paged mode,
+        the dense per-slot prefix otherwise), populate the prefix cache so
+        followers reuse the chunked prompt like any other, then run the
+        shared admission tail — first token from the LAST chunk's logits
+        with the submission-pinned seed."""
+        engine = self.engine
+        req, rows = pf.req, pf.rows
+        if self.paged:
+            for j, slot in enumerate(rows):
+                self._tables[slot] = list(pf.run_pages)
+                self._reserved[slot] = pf.reserved[j]
+                self._refresh_row_idx(slot, pf.plen)
+            if getattr(engine, "prefix_cache_size", 0) > 0:
+                from .paging import PagedPrefixRun
+
+                # One extra reference transfers to the cache entry; the
+                # run is already scattered, so the store is pure accounting.
+                self._pool.allocator.incref(pf.run_pages)
+                engine._prefix_store_paged_run(
+                    pf.ids, first_logits,
+                    PagedPrefixRun(self._pool, list(pf.run_pages),
+                                   pf.plen, pf.bucket),
+                )
+        else:
+            pk, pv = pf.cache.k, pf.cache.v
+            n = len(rows)
+            if pf.bucket < self.max_prompt:
+                pad = [(0, 0)] * 5
+                pad[2] = (0, self.max_prompt - pf.bucket)
+                pk, pv = jnp.pad(pk, pad), jnp.pad(pv, pad)
+            rows_arr = jnp.asarray(np.asarray(rows, np.int32))
+            rep_k = jnp.broadcast_to(pk[:, 0:1], (pk.shape[0], n) + pk.shape[2:])
+            rep_v = jnp.broadcast_to(pv[:, 0:1], (pv.shape[0], n) + pv.shape[2:])
+            self._prefix = self._write_prefix_fn(
+                self._prefix, rep_k, rep_v, rows_arr
+            )
+            if getattr(engine, "prefix_cache_size", 0) > 0:
+                engine._prefix_store(pf.ids, first_logits, pf.cache)
+        self._admit_rows(req, rows, first_logits)
+
+    def _retire_prefilling_locked(
+        self, exc: BaseException, abort: bool = False
+    ) -> None:
+        """Retire the PREFILLING admission before it ever decoded (lock
+        held): return its slots, release its pages (the run holds one
+        reference per row plus each row's reserve), and fail the future.
+        ``abort`` routes through the decode-abort counters — budget aborts
+        on a PREFILLING row share the decoding rows' fault domain."""
+        pf = self._prefilling
+        if pf is None:
+            return
+        self._prefilling = None
+        req = pf.req
+        if self.paged and self._pool is not None and pf.run_pages is not None:
+            alloc = self._pool.allocator
+            try:
+                for _ in pf.rows:
+                    alloc.decref(pf.run_pages)
+                for lst in pf.reserved:
+                    alloc.decref(lst)
+            except PageAccountingError:
+                # Containment over a corrupt allocator: drop the references
+                # (the pool audit quarantines it) so the future still fails
+                # typed instead of wedging retirement.
+                logger.exception(
+                    "page release failed retiring a PREFILLING admission"
+                )
+        for slot in pf.rows:
+            self._free.append(slot)
+        req.slots = []
+        if abort:
+            FAILURE_EVENTS.record("engine.decode_abort")
+            self._stats["aborted"] += 1
+        if not req.future.done():
+            req.future.set_exception(exc)
+        self._lock.notify_all()
 
     # -- paged slot management --------------------------------------------
 
@@ -1766,6 +2138,8 @@ class ContinuousDecodeLoop:
                             self._free.append(slot)
                 if not req.future.done():
                     req.future.set_exception(exc)
+            if self._prefilling is not None:
+                self._retire_prefilling_locked(exc)
             for req in self._queue:
                 if not req.future.done():
                     req.future.set_exception(exc)

@@ -43,6 +43,8 @@ from ..models.llama import (
     init_params,
     paged_verify_step,
     prefill,
+    prefill_chunk_step,
+    prefill_chunk_step_paged,
     prefill_continue,
     verify_step,
 )
@@ -464,6 +466,7 @@ class LocalEngine:
             "_active_budgets",
             "_active_token_sinks",
             "_tap_state",
+            "_kv_pool",
         )
 
         # Paged KV layout (engine/paging.py): prefix-cache entries and the
@@ -492,6 +495,10 @@ class LocalEngine:
         # against pool block tables too (dense stays the fallback on pool
         # exhaustion and the comparison baseline for differential tests).
         self.paged_generate_many = bool(paged_generate_many)
+        # Published once under _paged_mutex by _ensure_kv_pool and never
+        # replaced (a rebuild swaps the whole engine); unsynchronized readers
+        # (health(), loop sizing) tolerate the pre-publish None via getattr.
+        # kllms: unguarded — publish-once under _paged_mutex; readers tolerate None
         self._kv_pool: Optional[Any] = None
         # Serializes paged cache-entry/allocator mutation between the
         # continuous-loop worker and scheduler threads (dense entries are
@@ -536,6 +543,7 @@ class LocalEngine:
         self._sp_prefill_cache: Dict[Any, Any] = {}
         self._sp_continue_cache: Dict[Any, Any] = {}
         self._continue_cache: Dict[Any, Any] = {}
+        self._chunk_cache: Dict[Any, Any] = {}
         self._decode_cache: Dict[Any, Any] = {}
         self._spec_decode_cache: Dict[Any, Any] = {}
         self._embed_cache: Dict[Any, Any] = {}
@@ -773,6 +781,84 @@ class LocalEngine:
                 fn = jax.jit(_cont, donate_argnums=(2,))
             self._continue_cache[key] = fn
         return fn
+
+    def _get_prefill_chunk(self, c_bucket: int, total_bucket: int, paged: bool):
+        """Jitted chunked-prefill step (continuous loop): extend a staging
+        prefix cache by one C-token chunk at a dynamic cursor. The paged
+        variant additionally returns the chunk's KV columns for the caller to
+        scatter into the row's page run. Same model path as
+        :func:`_get_prefill_continue` — byte-identity with whole-prompt
+        prefill is structural, not re-derived."""
+        key = (c_bucket, total_bucket, paged)
+        fn = self._chunk_cache.get(key)
+        if fn is None:
+            step = prefill_chunk_step_paged if paged else prefill_chunk_step
+
+            def _chunk(params, chunk_tokens, cache, cursor, valid_len):
+                return step(
+                    self.config, params, chunk_tokens, cache, cursor, valid_len
+                )
+
+            if self.mesh is not None:
+                kv_sh = KVCache(
+                    k=NamedSharding(self.mesh, cache_specs(shared_prefix=True)),
+                    v=NamedSharding(self.mesh, cache_specs(shared_prefix=True)),
+                )
+                logits_sh = NamedSharding(self.mesh, P(None, None))
+                if paged:
+                    # Chunk KV columns [L, C, KVH, D]: heads shard tp, like
+                    # the pool they are scattered into.
+                    cols_sh = NamedSharding(self.mesh, P(None, None, MODEL_AXIS, None))
+                    out_shardings = (logits_sh, kv_sh, cols_sh, cols_sh)
+                else:
+                    out_shardings = (logits_sh, kv_sh)
+                fn = jax.jit(_chunk, out_shardings=out_shardings, donate_argnums=(2,))
+            else:
+                fn = jax.jit(_chunk, donate_argnums=(2,))
+            self._chunk_cache[key] = fn
+        return fn
+
+    def prefix_cached_len(self, prompt_ids: List[int]) -> int:
+        """How many leading tokens of ``prompt_ids`` the prefix cache can
+        supply without device work: the full length on a usable exact hit, the
+        common-prefix length on a partial hit past the reuse threshold, else
+        0. A pure probe — no LRU bump, no stats, no device work — used by the
+        continuous loop to decide whether a long admission should take the
+        cache path (zero/short prefill) or chunked prefill."""
+        if self.prefix_cache_size <= 0:
+            return 0
+        key = tuple(prompt_ids)
+        with self._paged_mutex:
+            hit = self._prefix_entries.get(key)
+            if hit is not None and not hit[4]:
+                return len(prompt_ids)
+        _, p = self._prefix_match(list(prompt_ids))
+        return p if p >= self.prefix_cache_min_reuse else 0
+
+    def _prefix_store_paged_run(self, ids: List[int], first_logits, run) -> None:
+        """Insert an ALREADY-SCATTERED page run as a prefix-cache entry (the
+        chunked-prefill finish path: the prompt's KV is already resident in
+        the pool, so re-deriving a run from dense would scatter it twice).
+        The caller transfers one reference to the cache; with the cache
+        disabled the reference is released immediately."""
+        from .paging import PagedPrefixRun
+
+        with self._paged_mutex:
+            if self.prefix_cache_size <= 0:
+                run.release()
+                return
+            key = tuple(ids)
+            old = self._prefix_entries.get(key)
+            if old is not None and isinstance(old[1], PagedPrefixRun):
+                old[1].release()
+            self._prefix_entries[key] = (
+                first_logits, run, len(ids), np.asarray(ids, np.int32), False
+            )
+            self._prefix_entries.move_to_end(key)
+            while len(self._prefix_entries) > self.prefix_cache_size:
+                _, evicted = self._prefix_entries.popitem(last=False)
+                if isinstance(evicted[1], PagedPrefixRun):
+                    evicted[1].release()
 
     @staticmethod
     def _kv_seq_sharded(kv: KVCache) -> bool:

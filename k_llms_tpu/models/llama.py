@@ -759,6 +759,59 @@ def prefill_continue(
     return logits, cache
 
 
+def prefill_chunk_step(
+    config: ModelConfig,
+    params: Params,
+    chunk_tokens: jax.Array,
+    cache: KVCache,
+    cursor: jax.Array,
+    valid_len: jax.Array,
+) -> Tuple[jax.Array, KVCache]:
+    """Extend a partially-filled prompt prefix by one chunk — the unit of
+    chunked prefill (Sarathi-style: prompt ingestion interleaved with decode
+    steps instead of one monolithic prefill).
+
+    ``chunk_tokens``: [1, C] the next C prompt tokens, right-padded;
+    ``cache``: [L, 1, B, KVH, D] staging cache holding positions 0..cursor;
+    ``cursor``: scalar absolute offset of this chunk's first token;
+    ``valid_len``: scalar count of non-pad tokens in the chunk.
+
+    Semantically a chunk IS a prompt-suffix continuation, so this delegates to
+    :func:`prefill_continue` — same ``_apply_stack``/``_block`` branches, same
+    absolute-position masks — which is what makes the final chunk's logits
+    byte-identical to whole-prompt prefill (pinned by the chunked-on/off
+    differential in tests/test_chunked_prefill.py). Returns (last-valid-token
+    logits [1, V] — meaningful only on the final chunk — and the updated
+    cache).
+    """
+    return prefill_continue(
+        config, params, chunk_tokens, cache, cursor, cursor + valid_len
+    )
+
+
+def prefill_chunk_step_paged(
+    config: ModelConfig,
+    params: Params,
+    chunk_tokens: jax.Array,
+    cache: KVCache,
+    cursor: jax.Array,
+    valid_len: jax.Array,
+) -> Tuple[jax.Array, KVCache, jax.Array, jax.Array]:
+    """Paged twin of :func:`prefill_chunk_step`: identical compute against the
+    dense staging cache (byte-identity comes for free from the shared path),
+    plus the chunk's freshly written KV columns sliced out so the caller can
+    ``scatter_tokens`` them into the row's reserved page run at its current
+    offset. Returns (logits [1, V], updated cache, k_cols [L, C, KVH, D],
+    v_cols [L, C, KVH, D])."""
+    C = chunk_tokens.shape[1]
+    logits, cache = prefill_chunk_step(
+        config, params, chunk_tokens, cache, cursor, valid_len
+    )
+    k_cols = jax.lax.dynamic_slice_in_dim(cache.k[:, 0], cursor, C, axis=1)
+    v_cols = jax.lax.dynamic_slice_in_dim(cache.v[:, 0], cursor, C, axis=1)
+    return logits, cache, k_cols, v_cols
+
+
 def decode_step(
     config: ModelConfig,
     params: Params,

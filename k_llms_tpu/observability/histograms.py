@@ -137,11 +137,17 @@ class LatencyHistograms:
 #: segment); ``batch.job_e2e`` — a whole job from durable submission to
 #: terminal status, wall clock, spanning restarts (the journal carries
 #: ``created_at``).
+#: The chunked-prefill family (ISSUE 18): ``continuous.prefill_chunk`` — one
+#: interleaved prompt-chunk dispatch's host wall time (device step + paged
+#: scatter + sync), observed per chunk by the continuous loop; compare its
+#: max against ``continuous.step`` p50 to verify long admissions no longer
+#: stall in-flight decode rows.
 LATENCY = LatencyHistograms(declared=(
     "request.e2e",
     "request.ttft",
     "scheduler.queue_wait",
     "continuous.step",
+    "continuous.prefill_chunk",
     "engine.decode_launch",
     "consensus.consolidate",
     "batch.item",

@@ -74,6 +74,14 @@ Injection sites wired in this package:
                            ``hang`` spec wedges the dispatch so the watchdog
                            must epoch-fence the abandoned thread, rebuild the
                            engine, and replay the journaled in-flight rows
+- ``continuous.prefill`` — evaluated inside the continuous loop's chunked-
+                           prefill device dispatch (``engine/continuous.py``),
+                           i.e. once per prompt chunk under the same watchdog
+                           budget as a decode step; a ``hang`` spec wedges the
+                           chunk mid-prompt so recovery must epoch-fence the
+                           abandoned thread, rebuild, and REPLAY the
+                           half-prefilled admission from cursor 0 with
+                           byte-identical output
 - ``continuous.worker``  — evaluated at the top of every continuous-loop
                            worker iteration, OUTSIDE the step-level error
                            guard; the ``crash`` action kills the worker thread
@@ -177,6 +185,7 @@ Env syntax (comma-separated):
     KLLMS_FAILPOINTS="engine.grammar=fallback:1"
     KLLMS_FAILPOINTS="engine.grammar=raise:1"
     KLLMS_FAILPOINTS="continuous.step=hang:1:3"
+    KLLMS_FAILPOINTS="continuous.prefill=hang:1:3"
     KLLMS_FAILPOINTS="continuous.worker=crash:1"
     KLLMS_FAILPOINTS="serving.trace=drop:2"
     KLLMS_FAILPOINTS="scheduler.tenant=exhaust:bulk:2"
@@ -219,6 +228,7 @@ SITES = (
     "ops.paged_attn",
     "engine.grammar",
     "continuous.step",
+    "continuous.prefill",
     "continuous.worker",
     "serving.trace",
     "scheduler.tenant",

@@ -32,6 +32,12 @@ content, so the output file can never hold two records for one item.
 The ``batch.store`` failpoint's ``torn`` action fires inside ``_append``:
 a prefix of the frame reaches the file, then the append raises — exactly the
 disk state a kill mid-write leaves behind, exercisable without a kill.
+
+Retention (ISSUE 18): with ``ttl_s`` set, open runs a one-shot sweep that
+GC's terminal jobs older than the TTL — a durable ``gc`` journal record (so
+the job can never resurrect from its earlier records), then directory
+removal, counted in ``batch.job_swept`` — plus an orphan pass for dirs with
+no journal row. Unfinished jobs never expire.
 """
 
 from __future__ import annotations
@@ -135,9 +141,12 @@ class JobStore:
         <root>/jobs/<id>/output.jsonl   assembled once the job is terminal
     """
 
-    def __init__(self, root: Any, *, fsync: bool = True) -> None:
+    def __init__(
+        self, root: Any, *, fsync: bool = True, ttl_s: Optional[float] = None
+    ) -> None:
         self.root = Path(root)
         self._fsync_enabled = fsync
+        self.ttl_s = float(ttl_s) if ttl_s else 0.0
         # Leaf lock: guards the job table and journal appends; never held
         # across a model call (the lane executes items outside it).
         self._lock = make_lock("reliability.jobstore")
@@ -147,6 +156,9 @@ class JobStore:
         self._journal_path = self.root / "journal.log"
         self._recover()
         self._fh = open(self._journal_path, "ab")
+        if self.ttl_s > 0:
+            with self._lock:
+                self._sweep_expired_locked()
 
     # -- journal framing ---------------------------------------------------
     def _append(self, payload: Dict[str, Any], sync: bool) -> None:
@@ -229,6 +241,12 @@ class JobStore:
                     job.status = rec.get("s", job.status)
                     if job.status == "cancelled":
                         job.cancelled = True
+            elif kind == "gc":
+                # Swept by a TTL pass: the job must NOT resurrect — without
+                # this record, replaying its "job" record against a deleted
+                # directory would revive it as a cancelled ghost (_reconcile
+                # sees no input.jsonl).
+                self._jobs.pop(rec.get("id"), None)
         for job in self._jobs.values():
             self._reconcile(job)
 
@@ -271,6 +289,35 @@ class JobStore:
             )
         if job.terminal and not (jobdir / "output.jsonl").exists():
             self._assemble(job)
+
+    # -- TTL sweep (ISSUE 18) ----------------------------------------------
+    def _sweep_expired_locked(self) -> None:
+        """GC terminal jobs older than ``ttl_s`` (age from submission — the
+        only timestamp the journal carries). Runs once per open, before any
+        concurrent writers exist. Order per job: durable ``gc`` journal
+        record first, then directory removal — a kill between the two leaves
+        a dir the orphan pass below deletes on the next open. Non-terminal
+        jobs never expire (the lane still owes them execution)."""
+        import shutil
+
+        now = time.time()
+        for jid in list(self._jobs):
+            job = self._jobs[jid]
+            if not job.terminal or now - job.created_at <= self.ttl_s:
+                continue
+            self._append({"t": "gc", "id": jid}, sync=True)
+            del self._jobs[jid]
+            shutil.rmtree(self._jobs_dir / jid, ignore_errors=True)
+            BATCH_EVENTS.record("batch.job_swept")
+            logger.info(
+                "jobstore: swept expired job %s (age %.0fs > ttl %.0fs)",
+                jid, now - job.created_at, self.ttl_s,
+            )
+        # Orphan pass: directories with no live job row — an interrupted
+        # rmtree above, or a create killed before its journal record.
+        for path in self._jobs_dir.iterdir():
+            if path.is_dir() and path.name not in self._jobs:
+                shutil.rmtree(path, ignore_errors=True)
 
     # -- job lifecycle -----------------------------------------------------
     def create_job(

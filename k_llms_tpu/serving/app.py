@@ -284,7 +284,10 @@ class ServingApp:
                 )
                 lane = BatchLane(
                     self.client,
-                    JobStore(root),
+                    JobStore(
+                        root,
+                        ttl_s=getattr(cfg, "jobstore_ttl_s", None),
+                    ),
                     max_in_flight=int(
                         getattr(cfg, "batch_max_in_flight", 4) or 4
                     ),

@@ -232,8 +232,10 @@ SERVE_EVENTS = EventCounters(declared=(
 #: consensus.device_busy — pair batches routed to the host Levenshtein because
 #: the chip lock was held; consensus.device_pairs / consensus.host_pairs /
 #: consensus.cached_pairs — where pair similarities came from;
-#: consensus.device_votes — vote columns tallied in the batched kernel), fed
-#: by consensus/device.py and surfaced via scheduler health and ``/metrics``.
+#: consensus.device_cosine — embedding pairs scored by the batched cosine
+#: kernel (ISSUE 18); consensus.device_votes — vote columns tallied in the
+#: batched kernel), fed by consensus/device.py and surfaced via scheduler
+#: health and ``/metrics``.
 CONSENSUS_EVENTS = EventCounters(declared=(
     "consensus.device_dispatch",
     "consensus.host_dispatch",
@@ -244,6 +246,7 @@ CONSENSUS_EVENTS = EventCounters(declared=(
     "consensus.device_pairs",
     "consensus.host_pairs",
     "consensus.cached_pairs",
+    "consensus.device_cosine",
     "consensus.device_votes",
 ))
 
@@ -338,9 +341,10 @@ TENANT_EVENTS = EventCounters(declared=(
 #: ``batch.worker_crashes`` — lane worker threads killed (the
 #: ``batch.worker=crash`` failpoint or a host bug); ``batch.store_torn_tail``
 #: — journal tails truncated on recovery (a kill mid-append, or the
-#: ``batch.store=torn`` failpoint). Fed by ``reliability/jobstore.py`` and
-#: ``serving/batch.py``; surfaced on ``/metrics`` as
-#: ``kllms_batch_events_total``.
+#: ``batch.store=torn`` failpoint); ``batch.job_swept`` — terminal jobs GC'd
+#: by the ``jobstore_ttl_s`` sweep on store open (ISSUE 18). Fed by
+#: ``reliability/jobstore.py`` and ``serving/batch.py``; surfaced on
+#: ``/metrics`` as ``kllms_batch_events_total``.
 BATCH_EVENTS = EventCounters(declared=(
     "batch.job_created",
     "batch.job_recovered",
@@ -352,6 +356,7 @@ BATCH_EVENTS = EventCounters(declared=(
     "batch.item_requeued",
     "batch.worker_crashes",
     "batch.store_torn_tail",
+    "batch.job_swept",
 ))
 
 

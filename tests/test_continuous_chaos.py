@@ -1,8 +1,10 @@
 """Mixed-traffic chaos soak for the self-healing continuous loop (PR 13).
 
-The ISSUE's acceptance drill: crash the worker thread, hang a decode step,
-poison logits, and leak KV pages — under concurrent streaming, grammar-
-constrained, and plain n-way traffic on the continuous-batching backend.
+The ISSUE's acceptance drill: crash the worker thread, hang a decode step
+AND a prefill chunk, poison logits, and leak KV pages — under concurrent
+streaming, grammar-constrained, and plain n-way traffic on the
+continuous-batching backend (chunked prefill on, so long admissions ingest
+between decode steps while the faults land).
 Every request must resolve (success or typed error, never a hung future),
 rebuilds must stay bounded, the page pool must end conserved, the scheduler
 must end READY, and both the lock-order graph and the Eraser-style lockset
@@ -46,7 +48,10 @@ def _backend():
         continuous_max_prompt=128, continuous_max_new=64,
         watchdog_base_s=0.5, watchdog_per_token_s=0.01,
         watchdog_multiplier=1.0, watchdog_min_budget_s=8.0,
-        watchdog_max_budget_s=8.0, max_rebuilds=3,
+        watchdog_max_budget_s=8.0, max_rebuilds=4,
+        # Chunked prefill ON (PR 18): prompts past 32 tokens ingest chunk by
+        # chunk, so the soak also drills the PREFILLING fault domain.
+        prefill_chunk_tokens=32,
     )
 
 
@@ -65,7 +70,10 @@ def test_continuous_chaos_soak_mixed_traffic(monkeypatch):
     lock = threading.Lock()
 
     def worker(i):
-        msgs = [{"role": "user", "content": f"chaos {i}"}]
+        # One wave-2 lane carries a long prompt so a multi-chunk PREFILLING
+        # admission is in flight while the faults land.
+        content = ("chaos prefill " * 8) if i == 4 else f"chaos {i}"
+        msgs = [{"role": "user", "content": content}]
         try:
             if i % 3 == 0:
                 # Streaming lane: drain every chunk; a quarantined sample
@@ -115,12 +123,14 @@ def test_continuous_chaos_soak_mixed_traffic(monkeypatch):
         assert not any(t.is_alive() for t in wave1)
     assert RECOVERY_EVENTS.snapshot()["continuous.worker_crashes"] > crashes
 
-    # Wave 2 — hung step + NaN poison while seven mixed requests ride the
-    # restarted loop: the watchdog rebuilds and replays through the hang,
+    # Wave 2 — hung step + hung prefill chunk + NaN poison while seven mixed
+    # requests ride the restarted loop: the watchdog rebuilds and replays
+    # through both hangs (the chunked admission re-ingests from cursor 0),
     # quarantine absorbs the poisoned rows, and traffic keeps flowing.
     with fp.failpoints(
         {
             "continuous.step": FailSpec(action="hang", times=1, delay=30.0),
+            "continuous.prefill": FailSpec(action="hang", times=1, delay=30.0),
             "engine.logits": FailSpec(action="nan", kill=1, seed=13, times=2),
         }
     ):
@@ -160,7 +170,8 @@ def test_continuous_chaos_soak_mixed_traffic(monkeypatch):
     cont = backend.health()["continuous"]
     # Bounded recovery: the loop healed within its fault budget each time and
     # never went terminal (clean traffic below proves it).
-    assert 1 <= cont["restarts"] <= 4  # crash + hang + (leak on paged loops)
+    # crash + step hang + prefill hang + (leak on paged loops)
+    assert 1 <= cont["restarts"] <= 5
     if "pages" in cont:
         assert "quarantined" not in cont["pages"]
         assert cont["pages"]["loop_refs"] == 0

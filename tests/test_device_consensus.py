@@ -92,6 +92,77 @@ def test_batched_levenshtein_matches_native():
     assert got == want
 
 
+def test_batched_cosine_matches_host():
+    """Host parity for the embeddings kernel (ISSUE 18). This is the one
+    float-producing kernel, so the contract is tolerance-based — device f32
+    dot/norms vs host float64 — with the zero-norm floor, the [-1,1]->[0,1]
+    normalization, and the [1e-8, 1] clip mirrored exactly."""
+    import numpy as np
+
+    from k_llms_tpu.consensus.device import batched_cosine
+    from k_llms_tpu.consensus.similarity import cosine_similarity
+
+    rng = np.random.default_rng(5)
+    pairs = [(rng.normal(size=64).tolist(), rng.normal(size=64).tolist()) for _ in range(130)]
+    v = rng.normal(size=64).tolist()
+    pairs.append((v, v))  # identical: clips to exactly 1.0
+    pairs.append((v, (-np.asarray(v)).tolist()))  # antipodal: floors near 0
+    pairs.append(([0.0] * 64, v))  # zero norm: exact lower bound
+    pairs.append((rng.normal(size=16).tolist(), rng.normal(size=16).tolist()))  # 2nd dim group
+    got = batched_cosine(pairs)
+    want = [cosine_similarity(a, b) for a, b in pairs]
+    assert np.allclose(got, want, atol=1e-5)
+    assert got[-2] == 1e-8  # zero-norm floor is exact, not approximate
+    with pytest.raises(ValueError):
+        batched_cosine([([0.0] * 8, [0.0] * 4)])
+
+
+def test_embeddings_scorer_routes_pairs_through_device_cosine():
+    """End-to-end: an embeddings-method DeviceSimilarityScorer batches every
+    eligible pair through the cosine kernel (counted in
+    consensus.device_cosine), and consolidation output matches the host
+    embeddings scorer."""
+    import zlib
+
+    import numpy as np
+
+    def embed(texts):
+        out = []
+        for t in texts:
+            rng = np.random.default_rng(zlib.crc32(t.encode("utf-8")))
+            out.append(rng.normal(size=32).tolist())
+        return out
+
+    # Long enough to clear EMBEDDING_MIN_CHARS so the embeddings route (not
+    # the Levenshtein degrade) scores the content field.
+    base = "the quick brown fox jumps over the lazy dog near the river bank"
+    samples = [
+        json.dumps({"summary": base, "tag": "x"}),
+        json.dumps({"summary": base + " again", "tag": "x"}),
+        json.dumps({"summary": "a completely different sentence about tax law and accounting rules", "tag": "y"}),
+    ]
+    host = _consolidate(samples, SimilarityScorer(method="embeddings", embed_fn=embed))
+    scorer = DeviceSimilarityScorer(method="embeddings", embed_fn=embed)
+    before = CONSENSUS_EVENTS.snapshot()
+    content, likelihoods = _consolidate(samples, scorer)
+    after = CONSENSUS_EVENTS.snapshot()
+    assert after.get("consensus.device_cosine", 0) > before.get("consensus.device_cosine", 0)
+    assert content == host[0]
+
+    def flatten(node, out):
+        if isinstance(node, dict):
+            for v in node.values():
+                flatten(v, out)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                flatten(v, out)
+        elif isinstance(node, (int, float)):
+            out.append(float(node))
+        return out
+
+    assert np.allclose(flatten(likelihoods, []), flatten(host[1], []), atol=1e-5)
+
+
 def test_batched_votes_match_voting_consensus():
     rng = random.Random(7)
     pools = [

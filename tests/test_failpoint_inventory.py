@@ -36,7 +36,7 @@ def test_ast_registry_matches_runtime_registry():
     assert reg is not None
     sites = FailpointCoverageRule()._sites(reg)
     assert set(sites) == set(SITES)
-    assert len(sites) >= 20
+    assert len(sites) >= 21
     assert "ops.paged_attn" in sites  # PR 11: paged-attention kernel drill
     assert "engine.grammar" in sites  # PR 12: constrained-decoding drill
     assert "continuous.step" in sites  # PR 13: decode-step hang drill
@@ -45,6 +45,7 @@ def test_ast_registry_matches_runtime_registry():
     assert "scheduler.tenant" in sites  # PR 16: quota-exhaustion drill
     assert "batch.store" in sites  # PR 17: torn journal-append drill
     assert "batch.worker" in sites  # PR 17: batch-lane worker-crash drill
+    assert "continuous.prefill" in sites  # PR 18: mid-chunk prefill-hang drill
     for site in sites:
         sub, _, name = site.partition(".")
         assert sub and name, f"site {site!r} must be subsystem.name"
